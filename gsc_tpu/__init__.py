@@ -1,0 +1,27 @@
+"""gsc_tpu — TPU-native service-coordination RL framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capability surface of the GSC
+reference (farzad1132/GSC): deep-RL coordination of service function chains
+(SFCs) in multi-cloud networks, jointly deciding placement and traffic
+scheduling.  Where the reference runs one SimPy discrete-event simulator in
+one Python process on CPU (reference: src/rlsp/agents/simple_ddpg.py:106-108),
+gsc_tpu runs thousands of vectorized simulator replicas and the full
+DDPG/GNN learner on TPU:
+
+- ``gsc_tpu.topology``  — GraphML/YAML -> padded dense topology pytrees
+  (replaces coordsim/reader/reader.py's networkx graphs).
+- ``gsc_tpu.sim``       — batched fixed-step flow simulator as a pure
+  ``lax.scan`` (replaces the SimPy engine in coordsim/simulation/).
+- ``gsc_tpu.envs``      — functional reset/step RL environment with the four
+  reward objectives (replaces src/rlsp/envs/gym_env.py).
+- ``gsc_tpu.models``    — flax GATv2 embedder + actor/critic
+  (replaces src/rlsp/agents/models.py).
+- ``gsc_tpu.agents``    — jit-compiled DDPG learner with an on-device replay
+  buffer (replaces src/rlsp/agents/simple_ddpg.py + buffer.py).
+- ``gsc_tpu.parallel``  — mesh/sharding utilities: vmapped env replicas per
+  chip, data-parallel learner via shard_map (no analogue in the reference,
+  which has no parallelism of any kind).
+- ``gsc_tpu.ops``       — Pallas TPU kernels with XLA reference impls.
+"""
+
+__version__ = "0.1.0"

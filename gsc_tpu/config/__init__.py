@@ -1,0 +1,22 @@
+from .schema import (
+    AgentConfig,
+    EnvLimits,
+    MMPPState,
+    SchedulerConfig,
+    ServiceConfig,
+    ServiceFunction,
+    SimConfig,
+    SUPPORTED_OBJECTIVES,
+    SUPPORTED_OBSERVATIONS,
+    DROP_REASONS,
+)
+from .loader import load_agent, load_scheduler, load_service, load_sim
+from .registry import get_resource_function, register_resource_function
+
+__all__ = [
+    "AgentConfig", "EnvLimits", "MMPPState", "SchedulerConfig",
+    "ServiceConfig", "ServiceFunction", "SimConfig",
+    "SUPPORTED_OBJECTIVES", "SUPPORTED_OBSERVATIONS", "DROP_REASONS",
+    "load_agent", "load_scheduler", "load_service", "load_sim",
+    "get_resource_function", "register_resource_function",
+]

@@ -1,0 +1,42 @@
+"""Component registry: named, typed component lookup replacing ``eval``.
+
+The reference resolves component implementations by ``eval()``-ing class-name
+strings from config against pkgutil-flattened package namespaces
+(coordsim/simulation/flowsimulator.py:30-40, siminterface/simulator.py:130,
+coordsim/controller/__init__.py:9-17).  That pattern is both unsafe and
+incompatible with jit tracing.  Here components are plain callables (or
+factories of callables) registered under string keys; configs carry the key.
+
+Registries:
+- ``resource_functions``: load -> demanded node capacity, used by the node
+  admission check (reference: coordsim/flow_processors/base_processor.py:24-35;
+  per-SF functions dynamically imported at reader.py:60-72, default identity).
+  Entries must be jax-traceable elementwise functions.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_RESOURCE_FUNCTIONS: Dict[str, Callable] = {}
+
+
+def register_resource_function(name: str):
+    def deco(fn):
+        _RESOURCE_FUNCTIONS[name] = fn
+        return fn
+    return deco
+
+
+def get_resource_function(name: str) -> Callable:
+    try:
+        return _RESOURCE_FUNCTIONS[name]
+    except KeyError:
+        raise KeyError(
+            f"Unknown resource function {name!r}; registered: {sorted(_RESOURCE_FUNCTIONS)}"
+        ) from None
+
+
+@register_resource_function("default")
+def _identity(load):
+    """Default resource demand = load (reference: reader.py:86-87)."""
+    return load

@@ -1,0 +1,264 @@
+"""Typed configuration schema for the five config namespaces.
+
+The reference spreads configuration over five YAML namespaces — agent
+(configs/config/agent/sample_agent.yaml), simulator
+(configs/config/simulator/sample_config.yaml), service functions
+(configs/service_functions/abc.yaml), scheduler (configs/config/scheduler.yaml)
+and a GraphML network — validated ad hoc in src/rlsp/agents/main.py:249-276
+and coordsim/reader/reader.py:74-111, with component implementations selected
+by ``eval()`` of class-name strings (coordsim/simulation/simulatorparams.py:29-38,
+siminterface/simulator.py:130).
+
+Here every namespace is a frozen dataclass of plain Python scalars/tuples so
+configs are hashable and can be closed over by ``jax.jit``.  Component
+selection goes through a string->callable registry (``gsc_tpu.config.registry``)
+instead of ``eval``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Mapping, Optional, Tuple
+
+SUPPORTED_OBJECTIVES = ("prio-flow", "soft-deadline", "soft-deadline-exp", "weighted")
+# Observation components supported by the env (reference:
+# src/rlsp/envs/simulator_wrapper.py:178-235 builds these three vectors).
+SUPPORTED_OBSERVATIONS = ("ingress_traffic", "node_load", "node_cap")
+DROP_REASONS = ("TTL", "DECISION", "LINK_CAP", "NODE_CAP")
+
+
+@dataclass(frozen=True)
+class ServiceFunction:
+    """One SF's properties (reference: coordsim/reader/reader.py:74-111)."""
+
+    name: str
+    processing_delay_mean: float = 1.0
+    processing_delay_stdev: float = 1.0
+    startup_delay: float = 0.0
+    # Registry key of the resource demand function load -> demanded capacity
+    # (reference: dynamically imported per-SF ``resource_function``,
+    # coordsim/reader/reader.py:60-72; default is identity, reader.py:86-87).
+    resource_function_id: str = "default"
+
+
+@dataclass(frozen=True)
+class ServiceConfig:
+    """SFC catalog: chains of SFs (reference: configs/service_functions/abc.yaml)."""
+
+    # sfc name -> ordered tuple of SF names
+    sfc_list: Mapping[str, Tuple[str, ...]]
+    sf_list: Mapping[str, ServiceFunction]
+
+    def __post_init__(self):
+        for sfc, chain in self.sfc_list.items():
+            for sf in chain:
+                if sf not in self.sf_list:
+                    raise ValueError(f"SFC {sfc!r} references unknown SF {sf!r}")
+
+    @property
+    def num_sfcs(self) -> int:
+        return len(self.sfc_list)
+
+    @property
+    def max_chain_len(self) -> int:
+        return max(len(c) for c in self.sfc_list.values())
+
+    @property
+    def sf_names(self) -> Tuple[str, ...]:
+        return tuple(self.sf_list.keys())
+
+    @property
+    def sfc_names(self) -> Tuple[str, ...]:
+        return tuple(self.sfc_list.keys())
+
+
+@dataclass(frozen=True)
+class MMPPState:
+    """One state of the two-state Markov-modulated Poisson arrival process
+    (reference: coordsim/simulation/simulatorparams.py:100-121, 143-176)."""
+
+    name: str
+    inter_arr_mean: float
+    switch_p: float
+
+
+@dataclass(frozen=True)
+class SimConfig:
+    """Simulator/traffic configuration
+    (reference: configs/config/simulator/sample_config.yaml +
+    coordsim/simulation/simulatorparams.py:13-131).
+    """
+
+    inter_arrival_mean: float = 10.0
+    deterministic_arrival: bool = True
+    flow_dr_mean: float = 1.0
+    flow_dr_stdev: float = 0.0
+    flow_size_shape: float = 0.001
+    deterministic_size: bool = True
+    run_duration: float = 100.0
+    ttl_choices: Tuple[float, ...] = (100.0,)
+    vnf_timeout: float = 100.0
+
+    # Capacity overrides (reference: coordsim/reader/builders.py:9-26)
+    force_link_cap: Optional[float] = None
+    force_node_cap: Optional[Tuple[float, float]] = None
+
+    # MMPP two-state arrival model (reference: simulatorparams.py:100-121)
+    use_states: bool = False
+    init_state: Optional[str] = None
+    rand_init_state: bool = False
+    states: Tuple[MMPPState, ...] = ()
+
+    # Trace-driven traffic (reference: coordsim/trace_processor/trace_processor.py)
+    trace_path: Optional[str] = None
+
+    # Component registry keys (replaces eval()-resolved class name strings,
+    # reference: simulatorparams.py:29-38).
+    decision_maker: str = "wrr"          # weighted-round-robin (default_decision_maker.py)
+    controller: str = "duration"         # duration | per_flow (controller/)
+
+    # --- TPU engine parameters (new; no reference analogue) ---
+    # Substep quantum in ms for the fixed-step lax.scan engine.  The reference
+    # engine is continuous-time event-driven (SimPy); with default configs all
+    # delays are integer ms so dt=1.0 reproduces it exactly.
+    dt: float = 1.0
+    # Max concurrently active flows per replica (flow-table slots).
+    max_flows: int = 128
+    # Ring-buffer horizon (in substeps) for delayed capacity release.
+    release_horizon: int = 256
+    # Max arrivals buffered per ingress per control interval.
+    max_arrivals_per_run: int = 64
+    # Iterations of the monotone greedy-admission refinement (within-substep
+    # sequential capacity-admission semantics).
+    admission_iters: int = 3
+    # Rank levels for exact sequential WRR among same-substep collisions.
+    wrr_rank_levels: int = 4
+
+    def __post_init__(self):
+        if self.use_states and len(self.states) != 2:
+            raise ValueError("MMPP model requires exactly 2 states")
+        if self.run_duration <= 0 or self.dt <= 0:
+            raise ValueError("run_duration and dt must be positive")
+        if not self.ttl_choices:
+            raise ValueError("TTL must be set in config file")  # simulatorparams.py:41
+
+    @property
+    def substeps_per_run(self) -> int:
+        n = round(self.run_duration / self.dt)
+        if abs(n * self.dt - self.run_duration) > 1e-9:
+            raise ValueError("run_duration must be a multiple of dt")
+        return int(n)
+
+
+@dataclass(frozen=True)
+class AgentConfig:
+    """Agent/learning configuration
+    (reference: configs/config/agent/sample_agent.yaml, validated in
+    src/rlsp/agents/main.py:249-276).
+    """
+
+    observation_space: Tuple[str, ...] = ("ingress_traffic", "node_load", "node_cap")
+    link_observation_space: Tuple[str, ...] = ("delay", "link_load")
+    graph_mode: bool = True
+    shuffle_nodes: bool = False
+    episode_steps: int = 200
+    agent_type: str = "DDPG"
+
+    # GNN (reference: sample_agent.yaml:29-32, models.py:10-53)
+    gnn_features: int = 22
+    gnn_num_layers: int = 2
+    gnn_num_iter: int = 2
+    gnn_aggr: str = "mean"
+    actor_hidden_layer_nodes: Tuple[int, ...] = (256,)
+    actor_hidden_layer_activation: str = "relu"
+    critic_hidden_layer_nodes: Tuple[int, ...] = (64,)
+    critic_hidden_layer_activation: str = "relu"
+
+    # objective / reward (reference: gym_env.py:300-380)
+    objective: str = "weighted"
+    flow_weight: float = 1.0
+    delay_weight: float = 0.0
+    node_weight: float = 0.0
+    instance_weight: float = 0.0
+    target_success: float | str = "auto"
+    soft_deadline: float = 10.0
+    dropoff: float = 10.0
+
+    # replay / exploration / optimization (reference: sample_agent.yaml:38-65)
+    mem_limit: int = 10000
+    rand_theta: float = 0.15
+    rand_mu: float = 0.0
+    rand_sigma: float = 0.3
+    nb_steps_warmup_critic: int = 200
+    nb_steps_warmup_actor: int = 200
+    gamma: float = 0.99
+    target_model_update: float = 1e-4
+    learning_rate: float = 1e-3
+    learning_rate_decay: float = 1e-3
+    batch_size: int = 100
+
+    # action post-processing (reference: simple_ddpg.py:130-131)
+    schedule_threshold: float = 0.1
+
+    def __post_init__(self):
+        if self.objective not in SUPPORTED_OBJECTIVES:
+            raise ValueError(
+                f"Unexpected objective {self.objective}. Must be in {SUPPORTED_OBJECTIVES}."
+            )
+        for obs in self.observation_space:
+            if obs not in SUPPORTED_OBSERVATIONS:
+                raise ValueError(f"Unsupported observation component {obs!r}")
+        if self.objective == "prio-flow" and self.target_success != "auto":
+            if not 0 <= float(self.target_success) <= 1:
+                raise ValueError("target_success must be in [0,1] or 'auto'")
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Topology schedule across training (reference: configs/config/scheduler.yaml,
+    consumed by src/rlsp/envs/gym_env.py:103-128)."""
+
+    training_network_files: Tuple[str, ...]
+    inference_network: str
+    period: int = 10
+
+    def __post_init__(self):
+        if not self.training_network_files:
+            raise ValueError("training_network_files must not be empty")
+        if self.period <= 0:
+            raise ValueError("period must be positive")
+
+
+@dataclass(frozen=True)
+class EnvLimits:
+    """Fixed padded dimensions enabling cross-topology generalization
+    (reference: src/rlsp/envs/environment_limits.py:9-106 and the hard-coded
+    24-node/37-edge limits at gym_env.py:59-66)."""
+
+    max_nodes: int = 24
+    max_edges: int = 37
+    num_sfcs: int = 1
+    max_sfs: int = 3
+
+    @property
+    def scheduling_shape(self) -> Tuple[int, int, int, int]:
+        # (src node, sfc, sf, dst node) — environment_limits.py:44-51
+        return (self.max_nodes, self.num_sfcs, self.max_sfs, self.max_nodes)
+
+    @property
+    def action_dim(self) -> int:
+        n = 1
+        for s in self.scheduling_shape:
+            n *= s
+        return n
+
+    @classmethod
+    def for_service(cls, service: ServiceConfig, max_nodes: int = 24,
+                    max_edges: int = 37) -> "EnvLimits":
+        return cls(max_nodes=max_nodes, max_edges=max_edges,
+                   num_sfcs=service.num_sfcs, max_sfs=service.max_chain_len)
+
+
+def replace(cfg, **kw):
+    """Convenience dataclasses.replace passthrough."""
+    return dataclasses.replace(cfg, **kw)

@@ -1,0 +1,18 @@
+from .compiler import (
+    INF_DELAY,
+    NetworkSpec,
+    Topology,
+    compile_topology,
+    edge_weight,
+    geo_delay_ms,
+    load_topology,
+    read_graphml,
+    stack_topologies,
+)
+from . import synthetic
+
+__all__ = [
+    "INF_DELAY", "NetworkSpec", "Topology", "compile_topology", "edge_weight",
+    "geo_delay_ms", "load_topology", "read_graphml", "stack_topologies",
+    "synthetic",
+]

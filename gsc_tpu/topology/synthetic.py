@@ -1,0 +1,146 @@
+"""Programmatic topology builders + mutation tooling.
+
+Provides the scenario matrix the reference ships as GraphML assets
+(configs/networks/: abilene, synthetic triangle/line/2node, randomized-cap
+variants) and the topology-mutation utility (scripts/gen_networks.py:6-38)
+as code instead of checked-in XML.  The Abilene graph here is built from the
+public Internet Topology Zoo node list (city coordinates), with the same
+scale as the reference's benchmark scenario: 11 nodes / 14 edges / 4 ingress
+(abilene-in4-*: New York, Chicago, Washington DC, Seattle as ingress).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .compiler import (DEFAULT_LINK_DELAY, NetworkSpec, Topology,
+                       compile_topology, geo_delay_ms)
+
+# (label, lat, long) — public Topology Zoo Abilene city list.
+_ABILENE_CITIES = [
+    ("New York", 40.71427, -74.00597),
+    ("Chicago", 41.85003, -87.65005),
+    ("Washington DC", 38.89511, -77.03637),
+    ("Seattle", 47.60621, -122.33207),
+    ("Sunnyvale", 37.36883, -122.03635),
+    ("Los Angeles", 34.05223, -118.24368),
+    ("Denver", 39.73915, -104.9847),
+    ("Kansas City", 39.11417, -94.62746),
+    ("Houston", 29.76328, -95.36327),
+    ("Atlanta", 33.749, -84.38798),
+    ("Indianapolis", 39.76838, -86.15804),
+]
+_ABILENE_EDGES = [
+    (0, 1), (0, 2), (1, 10), (2, 9), (3, 4), (3, 6), (4, 5), (4, 6),
+    (5, 8), (6, 7), (7, 8), (7, 10), (8, 9), (9, 10),
+]
+
+
+def abilene(num_ingress: int = 4, link_cap: float = 1000.0,
+            node_cap_range: Tuple[int, int] = (1, 3),
+            seed: int = 0) -> NetworkSpec:
+    """Abilene with the first ``num_ingress`` cities as ingress and random
+    integer node caps in [lo, hi) — the shape of the reference's
+    abilene-in4-rand-cap1-2 benchmark scenario."""
+    rng = np.random.default_rng(seed)
+    n = len(_ABILENE_CITIES)
+    caps = [float(rng.integers(*node_cap_range)) for _ in range(n)]
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = []
+    for u, v in _ABILENE_EDGES:
+        _, lat1, lon1 = _ABILENE_CITIES[u]
+        _, lat2, lon2 = _ABILENE_CITIES[v]
+        edges.append((u, v, link_cap, geo_delay_ms(lat1, lon1, lat2, lon2)))
+    return NetworkSpec(node_caps=caps, node_types=types, edges=edges,
+                       node_names=[c[0] for c in _ABILENE_CITIES],
+                       coords=[(c[1], c[2]) for c in _ABILENE_CITIES])
+
+
+def triangle(node_caps: Sequence[float] = (10.0, 10.0, 10.0),
+             link_cap: float = 100.0, link_delay: float = 1.0,
+             num_ingress: int = 1) -> NetworkSpec:
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(3)]
+    edges = [(0, 1, link_cap, link_delay), (1, 2, link_cap, link_delay),
+             (0, 2, link_cap, link_delay)]
+    return NetworkSpec(node_caps=list(node_caps), node_types=types, edges=edges)
+
+
+def line(n: int = 3, node_cap: float = 10.0, link_cap: float = 100.0,
+         link_delay: float = 1.0, num_ingress: int = 1) -> NetworkSpec:
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = [(i, i + 1, link_cap, link_delay) for i in range(n - 1)]
+    return NetworkSpec(node_caps=[node_cap] * n, node_types=types, edges=edges)
+
+
+def two_node(node_caps: Sequence[float] = (5.0, 5.0), link_cap: float = 100.0,
+             link_delay: float = 1.0) -> NetworkSpec:
+    return NetworkSpec(node_caps=list(node_caps),
+                       node_types=["Ingress", "Normal"],
+                       edges=[(0, 1, link_cap, link_delay)])
+
+
+def random_network(n_nodes: int, avg_degree: float = 2.5,
+                   node_cap_range: Tuple[int, int] = (1, 4),
+                   link_cap: float = 1000.0,
+                   delay_range: Tuple[float, float] = (1.0, 10.0),
+                   num_ingress: int = 4, seed: int = 0) -> NetworkSpec:
+    """Random connected topology, the programmatic analogue of the
+    gen_networks.py-mutated training sets (scripts/gen_networks.py +
+    BASELINE config 4: 64-128 node randomized topologies)."""
+    rng = np.random.default_rng(seed)
+    caps = [float(rng.integers(*node_cap_range)) for _ in range(n_nodes)]
+    ing = rng.choice(n_nodes, size=min(num_ingress, n_nodes), replace=False)
+    types = ["Ingress" if i in ing else "Normal" for i in range(n_nodes)]
+    edges: List[Tuple[int, int, float, float]] = []
+    seen = set()
+
+    def add(u, v):
+        if u != v and (u, v) not in seen and (v, u) not in seen:
+            seen.add((u, v))
+            edges.append((u, v, link_cap, float(np.around(rng.uniform(*delay_range)))))
+
+    # random spanning tree first (guarantees connectivity)
+    perm = rng.permutation(n_nodes)
+    for i in range(1, n_nodes):
+        add(int(perm[rng.integers(0, i)]), int(perm[i]))
+    target_edges = int(avg_degree * n_nodes / 2)
+    while len(edges) < target_edges:
+        add(int(rng.integers(n_nodes)), int(rng.integers(n_nodes)))
+    return NetworkSpec(node_caps=caps, node_types=types, edges=edges)
+
+
+def mutate_caps(spec: NetworkSpec, cap_range: Tuple[int, int],
+                seed: int = 0) -> NetworkSpec:
+    """Rewrite node caps with random values (gen_networks.py:6-21)."""
+    rng = np.random.default_rng(seed)
+    return NetworkSpec(
+        node_caps=[float(rng.integers(*cap_range)) for _ in spec.node_caps],
+        node_types=list(spec.node_types), edges=list(spec.edges),
+        node_names=list(spec.node_names), coords=spec.coords)
+
+
+def set_ingress(spec: NetworkSpec, nodes: Sequence[int]) -> NetworkSpec:
+    """Mark the given nodes as Ingress (gen_networks.py:24-38)."""
+    types = ["Ingress" if i in set(nodes) else t
+             for i, t in enumerate(spec.node_types)]
+    return NetworkSpec(node_caps=list(spec.node_caps), node_types=types,
+                       edges=list(spec.edges), node_names=list(spec.node_names),
+                       coords=spec.coords)
+
+
+def write_graphml(spec: NetworkSpec, path: str) -> None:
+    """Persist a NetworkSpec as a reference-compatible GraphML asset."""
+    import networkx as nx
+
+    g = nx.Graph()
+    for i, cap in enumerate(spec.node_caps):
+        attrs = dict(NodeCap=cap, NodeType=spec.node_types[i])
+        if spec.node_names:
+            attrs["label"] = spec.node_names[i]
+        if spec.coords:
+            attrs["Latitude"], attrs["Longitude"] = spec.coords[i]
+        g.add_node(i, **attrs)
+    for u, v, cap, delay in spec.edges:
+        g.add_edge(u, v, LinkFwdCap=cap, LinkDelay=delay)
+    nx.write_graphml(g, path)

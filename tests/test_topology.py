@@ -1,0 +1,119 @@
+"""Topology compiler tests.
+
+Checks the padded dense compilation against hand-computable graphs and the
+reference's weight/delay rules (coordsim/reader/reader.py:114-250).
+"""
+import numpy as np
+import pytest
+
+from gsc_tpu.topology import (INF_DELAY, compile_topology, edge_weight,
+                              load_topology, stack_topologies, synthetic)
+
+
+def test_edge_weight_rules():
+    # reader.py:114-126
+    assert edge_weight(0.0, 5.0) == float("inf")
+    assert edge_weight(10.0, 0.0) == 0.0
+    assert edge_weight(10.0, 2.0) == 1.0 / (10.0 + 0.5)
+
+
+def test_triangle_compiles():
+    topo = compile_topology(synthetic.triangle(), max_nodes=8, max_edges=8)
+    assert int(topo.n_nodes) == 3 and int(topo.n_edges) == 3
+    assert topo.node_mask.sum() == 3 and topo.edge_mask.sum() == 3
+    # direct edges exist: path delay 1 between every pair
+    pd = np.asarray(topo.path_delay)
+    for i in range(3):
+        assert pd[i, i] == 0
+        for j in range(3):
+            if i != j:
+                assert pd[i, j] == 1.0
+    # padded pairs unreachable
+    assert pd[0, 5] == INF_DELAY
+    assert int(topo.next_hop[0, 5]) == -1
+    assert float(topo.diameter) == 1.0
+
+
+def test_line_next_hop():
+    topo = compile_topology(synthetic.line(4), max_nodes=8, max_edges=8)
+    nh = np.asarray(topo.next_hop)
+    assert nh[0, 3] == 1 and nh[1, 3] == 2 and nh[2, 3] == 3
+    assert nh[3, 0] == 2
+    assert float(np.asarray(topo.path_delay)[0, 3]) == 3.0
+    assert float(topo.diameter) == 3.0
+
+
+def test_adj_edge_id_undirected():
+    topo = compile_topology(synthetic.two_node(), max_nodes=4, max_edges=4)
+    adj = np.asarray(topo.adj_edge_id)
+    assert adj[0, 1] == adj[1, 0] == 0
+    assert adj[0, 0] == -1
+
+
+def test_abilene_scale_parity():
+    # Benchmark scenario scale: 11 nodes / 14 edges / 4 ingress
+    # (reference: configs/networks/abilene/abilene-in4-rand-cap1-2.graphml).
+    spec = synthetic.abilene()
+    topo = compile_topology(spec)
+    assert int(topo.n_nodes) == 11 and int(topo.n_edges) == 14
+    assert int(topo.is_ingress.sum()) == 4
+    # geo delays: NY-Chicago ~1140km -> ~3ms at 0.77c (reader.py:163-225)
+    d = float(np.asarray(topo.edge_delay)[0])
+    assert 2 <= d <= 5
+
+
+def test_graphml_roundtrip(tmp_path):
+    spec = synthetic.abilene()
+    path = str(tmp_path / "abilene.graphml")
+    synthetic.write_graphml(spec, path)
+    topo = load_topology(path)
+    ref = compile_topology(spec)
+    np.testing.assert_allclose(np.asarray(topo.node_cap), np.asarray(ref.node_cap))
+    np.testing.assert_allclose(np.asarray(topo.path_delay), np.asarray(ref.path_delay))
+    assert int(topo.is_ingress.sum()) == 4
+
+
+def test_stacking():
+    t1 = compile_topology(synthetic.triangle(), max_nodes=8, max_edges=8)
+    t2 = compile_topology(synthetic.line(3), max_nodes=8, max_edges=8)
+    stacked = stack_topologies([t1, t2])
+    assert stacked.node_cap.shape == (2, 8)
+    assert stacked.next_hop.shape == (2, 8, 8)
+
+
+def test_random_network_connected():
+    spec = synthetic.random_network(32, seed=3)
+    topo = compile_topology(spec, max_nodes=32, max_edges=64)
+    pd = np.asarray(topo.path_delay)[:32, :32]
+    assert (pd < INF_DELAY).all(), "random network must be connected"
+
+
+def test_config_loading(tmp_path):
+    from gsc_tpu.config import load_agent, load_service, load_sim
+
+    (tmp_path / "svc.yaml").write_text(
+        "sfc_list:\n  sfc_1: [a, b, c]\n"
+        "sf_list:\n  a: {processing_delay_mean: 5.0, processing_delay_stdev: 0.0}\n"
+        "  b: {processing_delay_mean: 5.0, processing_delay_stdev: 0.0}\n"
+        "  c: {processing_delay_mean: 5.0, processing_delay_stdev: 0.0}\n")
+    svc = load_service(str(tmp_path / "svc.yaml"))
+    assert svc.num_sfcs == 1 and svc.max_chain_len == 3
+    assert svc.sf_list["a"].processing_delay_mean == 5.0
+    assert svc.sf_list["a"].startup_delay == 0.0  # default (reader.py:84)
+
+    (tmp_path / "sim.yaml").write_text(
+        "inter_arrival_mean: 10.0\ndeterministic: True\nflow_dr_mean: 1.0\n"
+        "flow_dr_stdev: 0.0\nflow_size_shape: 0.001\nrun_duration: 100\n"
+        "ttl_choices: [100]\n")
+    sim = load_sim(str(tmp_path / "sim.yaml"))
+    assert sim.deterministic_arrival and sim.deterministic_size
+    assert sim.substeps_per_run == 100
+
+    (tmp_path / "agent.yaml").write_text(
+        "graph_mode: True\nepisode_steps: 200\nGNN_features: 22\n"
+        "objective: weighted\nflow_weight: 1\n")
+    ag = load_agent(str(tmp_path / "agent.yaml"))
+    assert ag.gnn_features == 22 and ag.objective == "weighted"
+
+    with pytest.raises(ValueError):
+        load_agent(str(tmp_path / "agent.yaml"), objective="nope")
