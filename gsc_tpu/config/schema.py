@@ -20,6 +20,40 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Tuple
 
+
+class FrozenMap(Mapping):
+    """Immutable, hashable mapping (insertion-ordered) so configs that carry
+    mappings stay usable as static jit arguments."""
+
+    __slots__ = ("_items", "_lookup")
+
+    def __init__(self, data):
+        items = tuple(data.items()) if isinstance(data, Mapping) else tuple(data)
+        object.__setattr__(self, "_items", items)
+        object.__setattr__(self, "_lookup", dict(items))
+
+    def __getitem__(self, key):
+        return self._lookup[key]
+
+    def __iter__(self):
+        return (k for k, _ in self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __hash__(self):
+        return hash(self._items)
+
+    def __eq__(self, other):
+        if isinstance(other, FrozenMap):
+            return self._items == other._items
+        if isinstance(other, Mapping):
+            return dict(self._items) == dict(other)
+        return NotImplemented
+
+    def __repr__(self):
+        return f"FrozenMap({dict(self._items)!r})"
+
 SUPPORTED_OBJECTIVES = ("prio-flow", "soft-deadline", "soft-deadline-exp", "weighted")
 # Observation components supported by the env (reference:
 # src/rlsp/envs/simulator_wrapper.py:178-235 builds these three vectors).
@@ -50,6 +84,9 @@ class ServiceConfig:
     sf_list: Mapping[str, ServiceFunction]
 
     def __post_init__(self):
+        # normalize to hashable mappings (dataclass is frozen -> object.__setattr__)
+        object.__setattr__(self, "sfc_list", FrozenMap(self.sfc_list))
+        object.__setattr__(self, "sf_list", FrozenMap(self.sf_list))
         for sfc, chain in self.sfc_list.items():
             for sf in chain:
                 if sf not in self.sf_list:

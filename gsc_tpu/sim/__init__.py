@@ -1,0 +1,14 @@
+"""TPU-native batched flow simulator (replaces coordsim's SimPy core)."""
+from .state import (  # noqa: F401
+    DROP_DECISION,
+    DROP_LINK_CAP,
+    DROP_NODE_CAP,
+    DROP_REASONS,
+    DROP_TTL,
+    FlowTable,
+    SimMetrics,
+    SimState,
+    TrafficSchedule,
+)
+from .engine import ServiceTables, SimEngine  # noqa: F401
+from .traffic import TraceEvents, generate_traffic, traffic_capacity  # noqa: F401

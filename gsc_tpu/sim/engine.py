@@ -1,0 +1,526 @@
+"""Batched fixed-step flow simulation engine.
+
+The functional replacement for the reference's SimPy discrete-event core
+(coordsim/simulation/flowsimulator.py + forwarders/processors/decision_maker).
+One *control interval* (= one RL step, ``run_duration`` ms) is a ``lax.scan``
+over ``run_duration/dt`` fixed substeps; each substep advances every flow slot
+in the preallocated ``FlowTable`` in parallel.  There is no data-dependent
+Python control flow — the whole episode jits, vmaps over env replicas, and
+shards over device meshes.
+
+Per-substep pipeline (mirroring the reference's per-flow state machine,
+flowsimulator.py:72-128):
+ 1. release capacities whose hold time elapsed (ring buffers; the analogue of
+    the delayed ``return_link_resources`` / ``finish_processing`` SimPy
+    processes, default_forwarder.py:112-125, base_processor.py:103-135)
+ 2. advance HOP/PROC timers; completed PROC flows advance their SFC position
+    (base_processor.py:104-107) and re-enter decision; completed hops either
+    continue the path, arrive for processing, or depart at egress
+ 3. admit new arrivals from the pre-generated TrafficSchedule into free slots
+ 4. decisions: egress routing for finished flows (default_decision_maker.py:
+    27-31) and weighted-round-robin next-node selection against the
+    scheduling table with per-(node,SFC,SF) realized-ratio counters
+    (default_decision_maker.py:42-66); same-substep collisions in one cell
+    are serialized over ``wrr_rank_levels`` rounds
+ 5. forwarding: upfront whole-path TTL check (default_forwarder.py:35-39),
+    then hop-by-hop traversal with per-edge capacity admission
+    (default_forwarder.py:95-111); same-substep contention on an edge is
+    resolved greedily in slot order via iterative prefix-sum refinement
+ 6. processing: SF-placement check (default_processor.py:30-50), processing
+    delay sampling |N(mean, stdev)| with TTL check (base_processor.py:37-49),
+    node capacity admission through per-SF resource functions
+    (base_processor.py:24-35, 51-101), startup-delay wait, delayed load
+    release after the flow duration
+ 7. departures and drops with the reference's 4-reason taxonomy
+    (metrics.py:144-164; a drop with TTL<=0 is always recorded as TTL)
+
+Known, documented divergences from the event-driven reference:
+- time is quantized to ``dt`` (default 1 ms — exact for the default integer-
+  delay configs); sampled delays are credited to metrics exactly, only state
+  transitions snap to substep boundaries
+- same-instant orderings inside one substep follow flow-slot order instead of
+  SimPy's FIFO queue order
+- same-substep capacity contention uses ``admission_iters`` refinement
+  rounds, which equals greedy slot-order admission except in pathological
+  cascades
+- a flow whose TTL expires during a VNF startup wait releases its node load
+  (the reference leaks it, base_processor.py:86-97)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.registry import get_resource_function
+from ..config.schema import EnvLimits, ServiceConfig, SimConfig
+from ..topology.compiler import Topology
+from .state import (
+    DROP_DECISION,
+    DROP_LINK_CAP,
+    DROP_NODE_CAP,
+    DROP_TTL,
+    PH_DECIDE,
+    PH_FREE,
+    PH_HOP,
+    PH_PROC,
+    FlowTable,
+    SimMetrics,
+    SimState,
+    TrafficSchedule,
+    init_state,
+)
+
+_EPS = 1e-4
+# arrivals admitted per substep; later arrivals spill to the next substep
+# (with default dt=1ms this is never binding outside extreme overload)
+_ARRIVALS_PER_SUBSTEP = 8
+
+
+@dataclass(frozen=True)
+class ServiceTables:
+    """Static per-service tensors derived from ServiceConfig."""
+
+    chain_sf: np.ndarray      # [C, S] i32 SF index per chain position (-1 pad)
+    chain_len: np.ndarray     # [C] i32
+    proc_mean: np.ndarray     # [S] f32
+    proc_std: np.ndarray      # [S] f32
+    startup_delay: np.ndarray  # [S] f32
+    resource_fns: Tuple[Callable, ...]  # per SF index
+
+    @classmethod
+    def build(cls, service: ServiceConfig, limits: EnvLimits) -> "ServiceTables":
+        sf_names = list(service.sf_names)
+        s = limits.max_sfs
+        c = limits.num_sfcs
+        chain_sf = np.full((c, s), -1, np.int32)
+        chain_len = np.zeros(c, np.int32)
+        for ci, name in enumerate(service.sfc_names):
+            chain = service.sfc_list[name]
+            chain_len[ci] = len(chain)
+            for si, sf in enumerate(chain):
+                chain_sf[ci, si] = sf_names.index(sf)
+        proc_mean = np.zeros(s, np.float32)
+        proc_std = np.zeros(s, np.float32)
+        startup = np.zeros(s, np.float32)
+        fns = []
+        for i, name in enumerate(sf_names[:s]):
+            sf = service.sf_list[name]
+            proc_mean[i] = sf.processing_delay_mean
+            proc_std[i] = sf.processing_delay_stdev
+            startup[i] = sf.startup_delay
+            fns.append(get_resource_function(sf.resource_function_id))
+        while len(fns) < s:
+            fns.append(get_resource_function("default"))
+        return cls(chain_sf=chain_sf, chain_len=chain_len, proc_mean=proc_mean,
+                   proc_std=proc_std, startup_delay=startup,
+                   resource_fns=tuple(fns))
+
+
+def _rank_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
+                  num_cells: int) -> jnp.ndarray:
+    """rank[m] = #(flows m'<m with mask and same cell).  [M] i32."""
+    onehot = (cell_id[:, None] == jnp.arange(num_cells)[None, :]) & mask[:, None]
+    prefix = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    return jnp.take_along_axis(
+        prefix, jnp.clip(cell_id, 0)[:, None], axis=1)[:, 0] - 1
+
+
+def _prefix_sum_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
+                        vals: jnp.ndarray, num_cells: int) -> jnp.ndarray:
+    """Inclusive per-cell prefix sum of vals over masked flows, in slot order."""
+    onehot = (cell_id[:, None] == jnp.arange(num_cells)[None, :]) & mask[:, None]
+    contrib = jnp.where(onehot, vals[:, None], 0.0)
+    prefix = jnp.cumsum(contrib, axis=0)
+    return jnp.take_along_axis(
+        prefix, jnp.clip(cell_id, 0)[:, None], axis=1)[:, 0]
+
+
+class SimEngine:
+    """Factory-built engine closing over static config.
+
+    ``init(rng, topo)`` -> SimState (the analogue of SimulatorInterface.init,
+    spinterface.py:199-218, without running any events — matching the
+    reference's init which only executes the t=0 bookkeeping event,
+    duration_controller.py:20-33).
+
+    ``apply(state, topo, traffic, schedule, placement)`` -> (state', metrics)
+    runs one control interval (SimulatorInterface.apply / DurationController.
+    get_next_state, duration_controller.py:35-77).
+    """
+
+    def __init__(self, service: ServiceConfig, cfg: SimConfig, limits: EnvLimits):
+        self.service = service
+        self.cfg = cfg
+        self.limits = limits
+        self.tables = ServiceTables.build(service, limits)
+        self.substeps = cfg.substeps_per_run
+        self.dt = cfg.dt
+        self.M = cfg.max_flows
+        self.H = cfg.release_horizon
+        self.N = limits.max_nodes
+        self.C = limits.num_sfcs
+        self.S = limits.max_sfs
+        self.E = limits.max_edges
+        max_hold = (self.H - 1) * self.dt
+        if cfg.run_duration > max_hold:
+            raise ValueError("release_horizon must cover at least one run_duration")
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng, topo: Topology) -> SimState:
+        del topo  # shapes are static; topology enters at apply()
+        return init_state(rng, self.M, self.N, self.C, self.S, self.E, self.H)
+
+    # ------------------------------------------------------- demanded capacity
+    def _demanded(self, load_plus: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
+        """Total demanded node capacity given per-SF loads [..., S] summed over
+        available SFs through per-SF resource functions
+        (base_processor.py:24-35)."""
+        cols = []
+        for s, fn in enumerate(self.tables.resource_fns):
+            cols.append(jnp.where(avail[..., s], fn(load_plus[..., s]), 0.0))
+        return jnp.stack(cols, axis=-1).sum(axis=-1)
+
+    # ------------------------------------------------------------- one interval
+    @partial(jax.jit, static_argnums=0)
+    def apply(self, state: SimState, topo: Topology, traffic: TrafficSchedule,
+              schedule: jnp.ndarray, placement: jnp.ndarray
+              ) -> Tuple[SimState, SimMetrics]:
+        # --- apply the action (duration_controller.py:44-64) ---
+        available = placement | (state.node_load > _EPS)
+        newly = available & ~state.sf_available
+        state = state.replace(
+            placed=placement,
+            schedule=schedule,
+            sf_available=available,
+            sf_startup=jnp.where(newly, state.t, state.sf_startup),
+            # run metrics reset at interval start (writer.py:222-225)
+            metrics=state.metrics.reset_run(),
+        )
+        t_steps = traffic.node_cap.shape[0]
+        cap_now = traffic.node_cap[jnp.clip(state.run_idx, 0, t_steps - 1)]
+
+        def sub(st, _):
+            return self._substep(st, topo, traffic, cap_now), None
+
+        state, _ = jax.lax.scan(sub, state, None, length=self.substeps)
+        state = state.replace(run_idx=state.run_idx + 1)
+        return state, state.metrics
+
+    # ---------------------------------------------------------------- substep
+    def _substep(self, state: SimState, topo: Topology,
+                 traffic: TrafficSchedule, cap_now: jnp.ndarray) -> SimState:
+        F = state.flows
+        m = state.metrics
+        dt = self.dt
+        t = state.t
+        g = jnp.round(t / dt).astype(jnp.int32)       # global substep index
+        ridx = jnp.mod(g, self.H)                      # ring-buffer index
+        slots = jnp.arange(self.M)
+        rng, k_proc = jax.random.split(state.rng)
+
+        # --- 1. capacity releases ------------------------------------------
+        node_load = jnp.maximum(state.node_load - state.rel_node[ridx], 0.0)
+        edge_used = jnp.maximum(state.edge_used - state.rel_edge[ridx], 0.0)
+        rel_node = state.rel_node.at[ridx].set(0.0)
+        rel_edge = state.rel_edge.at[ridx].set(0.0)
+        # graceful SF removal once drained and unplaced (base_processor.py:115-118)
+        sf_available = state.sf_available & (state.placed | (node_load > _EPS))
+
+        # --- 2. timers ------------------------------------------------------
+        running = (F.phase == PH_HOP) | (F.phase == PH_PROC)
+        timer = jnp.where(running, F.timer - dt, F.timer)
+        proc_done = (F.phase == PH_PROC) & (timer <= _EPS)
+        hop_done = (F.phase == PH_HOP) & (timer <= _EPS)
+
+        # PROC completion: advance chain position, re-decide this substep
+        # (position increments when processing delay elapses,
+        # base_processor.py:103-107 at spawn time)
+        position = F.position + proc_done.astype(jnp.int32)
+        phase = jnp.where(proc_done, PH_DECIDE, F.phase)
+
+        # HOP completion: move to hop endpoint
+        node = jnp.where(hop_done, F.hop_next, F.node)
+        arrived = hop_done & (node == F.dest)
+        cont = hop_done & ~arrived                     # continue multi-hop path
+        # credit whole-path delay on arrival (default_forwarder.py:83-86)
+        e2e = F.e2e + jnp.where(arrived, F.pend_path, 0.0)
+        ttl = F.ttl - jnp.where(arrived, F.pend_path, 0.0)
+        n_arr = arrived.sum()
+        path_add = jnp.where(arrived, F.pend_path, 0.0).sum()
+        m = m.replace(
+            sum_path_delay=m.sum_path_delay + path_add,
+            num_path_delay=m.num_path_delay + n_arr,
+            run_path_delay_sum=m.run_path_delay_sum + path_add,
+        )
+        chain_len = jnp.asarray(self.tables.chain_len)[F.sfc]
+        to_eg_flag = position >= chain_len             # forward_to_eg
+        depart_hop = arrived & to_eg_flag              # reached egress: success
+        need_proc_a = arrived & ~to_eg_flag
+
+        # --- 3. arrivals ----------------------------------------------------
+        cand = state.cursor + jnp.arange(_ARRIVALS_PER_SUBSTEP)
+        cand_c = jnp.clip(cand, 0, traffic.capacity - 1)
+        due = (traffic.arr_time[cand_c] < t + dt - _EPS) & (cand < traffic.capacity) \
+            & jnp.isfinite(traffic.arr_time[cand_c])
+        free = phase == PH_FREE
+        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+        n_free = free.sum()
+        arr_rank = jnp.cumsum(due.astype(jnp.int32)) - 1
+        spawn = due & (arr_rank < n_free)
+        # slot_of_rank[r] = slot index of the r-th free slot
+        slot_of_rank = jnp.zeros(self.M, jnp.int32).at[
+            jnp.where(free, free_rank, self.M)].set(slots, mode="drop")
+        tgt = slot_of_rank[jnp.clip(arr_rank, 0, self.M - 1)]
+
+        def scatter_arr(arr, vals, fill=None):
+            return arr.at[jnp.where(spawn, tgt, self.M)].set(vals, mode="drop")
+
+        phase = scatter_arr(phase, PH_DECIDE)
+        node = scatter_arr(node, traffic.arr_ingress[cand_c])
+        position = scatter_arr(position, 0)
+        sfc = scatter_arr(F.sfc, traffic.arr_sfc[cand_c])
+        dr = scatter_arr(F.dr, traffic.arr_dr[cand_c])
+        duration = scatter_arr(F.duration, traffic.arr_duration[cand_c])
+        ttl = scatter_arr(ttl, traffic.arr_ttl[cand_c])
+        egress = scatter_arr(F.egress, traffic.arr_egress[cand_c])
+        e2e = scatter_arr(e2e, 0.0)
+        dest = scatter_arr(F.dest, -1)
+        pend_path = scatter_arr(F.pend_path, 0.0)
+        hop_next = F.hop_next
+        n_spawn = spawn.sum()
+        cursor = state.cursor + n_spawn
+        m = m.replace(
+            generated=m.generated + n_spawn,
+            run_generated=m.run_generated + n_spawn,
+            active=m.active + n_spawn,
+            run_requested_node=m.run_requested_node.at[
+                jnp.where(spawn, traffic.arr_ingress[cand_c], self.N)
+            ].add(jnp.where(spawn, traffic.arr_dr[cand_c], 0.0), mode="drop"),
+        )
+
+        # recompute flags after arrivals
+        chain_len = jnp.asarray(self.tables.chain_len)[sfc]
+        to_eg_flag = position >= chain_len
+
+        # --- 4. decisions ---------------------------------------------------
+        deciding = phase == PH_DECIDE
+        # TTL exhausted at decision time -> drop (decide_next_node returns
+        # None at ttl<=0, default_decision_maker.py:24-26; recorded as TTL,
+        # metrics.py:158-160)
+        drop_ttl0 = deciding & (ttl <= _EPS)
+        decide = deciding & ~drop_ttl0
+        to_eg = decide & to_eg_flag
+        # flows with no egress depart at their current node
+        # (default_decision_maker.py:28-31)
+        egress = jnp.where(to_eg & (egress < 0), node, egress)
+        wrr = decide & ~to_eg_flag
+
+        sf_pos = jnp.clip(position, 0, self.S - 1)
+        sf_now = jnp.asarray(self.tables.chain_sf)[jnp.clip(sfc, 0, self.C - 1),
+                                                   sf_pos]
+        sf_now = jnp.clip(sf_now, 0)
+        # requested-traffic metric for every WRR decision, before the schedule
+        # lookup (add_requesting_flow, default_decision_maker.py:35-36)
+        m = m.replace(run_requested=m.run_requested.at[
+            jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
+        ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
+
+        # WRR over the schedule row with realized-ratio counters
+        # (default_decision_maker.py:42-66); same-cell same-substep collisions
+        # run in slot-order rounds so later flows see updated counters
+        cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_now
+        rank = _rank_in_cell(cell, wrr, self.N * self.C * self.S)
+        flow_counts = m.run_flow_counts
+        R = self.cfg.wrr_rank_levels
+        for r in range(R):
+            sel = wrr & ((rank == r) if r < R - 1 else (rank >= r))
+            counts = flow_counts[node, jnp.clip(sfc, 0), sf_now]      # [M,N]
+            total = counts.sum(-1, keepdims=True)
+            ratios = jnp.where(total > 0, counts / jnp.maximum(total, 1), 0.0)
+            probs = schedule_row = state.schedule[node, jnp.clip(sfc, 0), sf_now]
+            diffs = jnp.where(probs > 0, probs - ratios, -1.0)
+            choice = jnp.argmax(diffs, axis=-1).astype(jnp.int32)
+            dest = jnp.where(sel, choice, dest)
+            flow_counts = flow_counts.at[
+                jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_now, choice
+            ].add(jnp.where(sel, 1, 0), mode="drop")
+        m = m.replace(run_flow_counts=flow_counts)
+        dest = jnp.where(to_eg, egress, dest)
+
+        # --- 5. forwarding --------------------------------------------------
+        fwd = decide
+        stay = fwd & (dest == node)
+        depart_stay = to_eg & stay                    # at egress already
+        need_proc_b = wrr & stay
+        start_path = fwd & ~stay
+        pd_path = topo.path_delay[node, jnp.clip(dest, 0)]
+        # upfront whole-path TTL check (default_forwarder.py:35-39);
+        # unreachable destinations have inf path delay and also drop here
+        drop_ttl_path = start_path & (ttl - pd_path <= _EPS)
+        ttl = jnp.where(drop_ttl_path, 0.0, ttl)
+        start_path = start_path & ~drop_ttl_path
+
+        # hop starts this substep: fresh paths + mid-path continuations
+        hop_req = cont | start_path
+        nh = topo.next_hop[node, jnp.clip(dest, 0)]
+        nh = jnp.clip(nh, 0)
+        eid = topo.adj_edge_id[node, nh]
+        eid_c = jnp.clip(eid, 0)
+        # greedy slot-order link admission via iterative refinement
+        # (deduct_link_resources, default_forwarder.py:95-111)
+        admitted = hop_req & (eid >= 0)
+        for _ in range(self.cfg.admission_iters):
+            prefix = _prefix_sum_in_cell(eid_c, admitted, dr, self.E)
+            admitted = hop_req & (eid >= 0) & (
+                edge_used[eid_c] + prefix <= topo.edge_cap[eid_c] + _EPS)
+        drop_link = hop_req & ~admitted
+        add_e = jnp.where(admitted, dr, 0.0)
+        edge_used = edge_used.at[jnp.where(admitted, eid_c, self.E)].add(
+            add_e, mode="drop")
+        m = m.replace(run_passed_traffic=m.run_passed_traffic.at[
+            jnp.where(admitted, eid_c, self.E)].add(add_e, mode="drop"))
+        hop_delay = topo.edge_delay[eid_c]
+        # release link capacity hop_delay + duration after the hop starts
+        # (default_forwarder.py:112-125)
+        off_e = jnp.clip(jnp.ceil((hop_delay + duration) / dt).astype(jnp.int32),
+                         1, self.H - 1)
+        rel_edge = rel_edge.at[
+            jnp.where(admitted, jnp.mod(ridx + off_e, self.H), self.H),
+            jnp.where(admitted, eid_c, self.E)].add(add_e, mode="drop")
+        pend_path = jnp.where(start_path & admitted, pd_path, pend_path)
+        hop_next = jnp.where(admitted, nh, hop_next)
+        timer = jnp.where(admitted, hop_delay, timer)
+        phase = jnp.where(admitted, PH_HOP, phase)
+
+        # --- 6. processing --------------------------------------------------
+        need_proc = need_proc_a | need_proc_b
+        sf_ok = state.placed[node, sf_now]
+        # SF not in placement -> drop (default_processor.py:48-50 ->
+        # NODE_CAP, flowsimulator.py:114-118)
+        drop_unplaced = need_proc & ~sf_ok
+        want = need_proc & sf_ok
+        pmean = jnp.asarray(self.tables.proc_mean)[sf_now]
+        pstd = jnp.asarray(self.tables.proc_std)[sf_now]
+        pdel = jnp.abs(jax.random.normal(k_proc, (self.M,)) * pstd + pmean)
+        # TTL check before the delay is credited (base_processor.py:37-44)
+        drop_ttl_pd = want & (ttl - pdel <= _EPS)
+        ttl = jnp.where(drop_ttl_pd, 0.0, ttl)
+        want = want & ~drop_ttl_pd
+        e2e = e2e + jnp.where(want, pdel, 0.0)
+        ttl = ttl - jnp.where(want, pdel, 0.0)
+        n_want = want.sum()
+        m = m.replace(
+            sum_proc_delay=m.sum_proc_delay + jnp.where(want, pdel, 0.0).sum(),
+            num_proc_delay=m.num_proc_delay + n_want,
+        )
+        # node capacity admission via resource functions, greedy slot order
+        # (request_resources, base_processor.py:51-101)
+        ns_cell = node * self.S + sf_now
+        admitted_n = want
+        demanded = jnp.zeros(self.M, jnp.float32)
+        for _ in range(self.cfg.admission_iters):
+            # per-(node, SF) inclusive prefix of admitted same-substep drs
+            onehot = (ns_cell[:, None] == jnp.arange(self.N * self.S)[None, :]) \
+                & admitted_n[:, None]
+            prefix_ns = jnp.cumsum(
+                jnp.where(onehot, dr[:, None], 0.0), axis=0
+            ).reshape(self.M, self.N, self.S)
+            load_plus = node_load[None] + prefix_ns            # [M,N,S]
+            load_mine = jnp.take_along_axis(
+                load_plus, node[:, None, None], axis=1)[:, 0]  # [M,S]
+            avail_mine = sf_available[node]                    # [M,S]
+            demanded = self._demanded(load_mine, avail_mine)
+            admitted_n = want & (demanded <= cap_now[node] + _EPS)
+        drop_nodecap = want & ~admitted_n
+        add_n = jnp.where(admitted_n, dr, 0.0)
+        node_load = node_load.at[
+            jnp.where(admitted_n, node, self.N), sf_now].add(add_n, mode="drop")
+        m = m.replace(
+            run_processed_traffic=m.run_processed_traffic.at[
+                jnp.where(admitted_n, node, self.N), sf_now
+            ].add(add_n, mode="drop"),
+            run_max_node_usage=m.run_max_node_usage.at[
+                jnp.where(admitted_n, node, self.N)
+            ].max(jnp.where(admitted_n, demanded, 0.0), mode="drop"),
+        )
+        # startup wait (base_processor.py:79-97); a TTL expiry here releases
+        # the load immediately (divergence: the reference leaks it)
+        sw = jnp.maximum(
+            state.sf_startup[node, sf_now]
+            + jnp.asarray(self.tables.startup_delay)[sf_now] - t, 0.0)
+        drop_ttl_sw = admitted_n & (ttl - sw <= _EPS) & (sw > _EPS)
+        ttl = jnp.where(drop_ttl_sw, 0.0, ttl)
+        started = admitted_n & ~drop_ttl_sw
+        e2e = e2e + jnp.where(started, sw, 0.0)
+        ttl = ttl - jnp.where(started, sw, 0.0)
+        busy = jnp.where(started, sw + pdel, 0.0)
+        timer = jnp.where(started, busy, timer)
+        phase = jnp.where(started, PH_PROC, phase)
+        # release node load busy + duration after processing starts
+        # (finish_processing waits flow.duration after the delay elapses,
+        # base_processor.py:103-112); TTL-in-startup drops release now
+        hold = jnp.where(started, busy + duration, dt)
+        rel_who = started | drop_ttl_sw
+        off_n = jnp.clip(jnp.ceil(hold / dt).astype(jnp.int32), 1, self.H - 1)
+        rel_node = rel_node.at[
+            jnp.where(rel_who, jnp.mod(ridx + off_n, self.H), self.H),
+            jnp.where(rel_who, node, self.N), sf_now
+        ].add(jnp.where(rel_who, dr, 0.0), mode="drop")
+
+        # --- 7. departures & drops -----------------------------------------
+        depart = depart_hop | depart_stay
+        n_dep = depart.sum()
+        dep_e2e = jnp.where(depart, e2e, 0.0)
+        m = m.replace(
+            processed=m.processed + n_dep,
+            run_processed=m.run_processed + n_dep,
+            sum_e2e=m.sum_e2e + dep_e2e.sum(),
+            run_e2e_sum=m.run_e2e_sum + dep_e2e.sum(),
+            run_e2e_max=jnp.maximum(m.run_e2e_max, dep_e2e.max()),
+            active=m.active - n_dep,
+        )
+        drops = [
+            (drop_ttl0, DROP_DECISION),
+            (drop_ttl_path, DROP_LINK_CAP),
+            (drop_link, DROP_LINK_CAP),
+            (drop_unplaced, DROP_NODE_CAP),
+            (drop_ttl_pd, DROP_NODE_CAP),
+            (drop_nodecap, DROP_NODE_CAP),
+            (drop_ttl_sw, DROP_NODE_CAP),
+        ]
+        any_drop = jnp.zeros(self.M, bool)
+        reasons = m.drop_reasons
+        for mask, reason in drops:
+            any_drop = any_drop | mask
+            # ttl<=0 always recorded as TTL (metrics.py:158-160)
+            is_ttl = mask & (ttl <= _EPS)
+            reasons = reasons.at[DROP_TTL].add(is_ttl.sum())
+            reasons = reasons.at[reason].add((mask & ~is_ttl).sum())
+        n_drop = any_drop.sum()
+        m = m.replace(
+            drop_reasons=reasons,
+            dropped=m.dropped + n_drop,
+            run_dropped=m.run_dropped + n_drop,
+            active=m.active - n_drop,
+            run_dropped_per_node=m.run_dropped_per_node.at[
+                jnp.where(any_drop, node, self.N)
+            ].add(jnp.where(any_drop, 1, 0), mode="drop"),
+        )
+        gone = depart | any_drop
+        phase = jnp.where(gone, PH_FREE, phase)
+
+        flows = FlowTable(phase=phase, sfc=sfc, position=position, node=node,
+                          dest=dest, hop_next=hop_next, egress=egress, dr=dr,
+                          duration=duration, ttl=ttl, e2e=e2e,
+                          pend_path=pend_path, timer=timer)
+        return state.replace(
+            t=t + dt, flows=flows, cursor=cursor, node_load=node_load,
+            sf_available=sf_available, edge_used=edge_used,
+            rel_node=rel_node, rel_edge=rel_edge, metrics=m, rng=rng,
+        )

@@ -1,0 +1,230 @@
+"""Simulator state pytrees.
+
+The reference keeps per-flow state in Python ``Flow`` objects driven by SimPy
+processes (coordsim/network/flow.py:10-48, coordsim/simulation/
+flowsimulator.py:59-128) and network state as networkx node/edge attribute
+dicts.  Here the whole simulation is a fixed-shape pytree so it can live in
+TPU HBM, be advanced by ``lax.scan`` and batched with ``vmap``:
+
+- ``FlowTable``: a preallocated table of MAX_FLOWS flow slots (struct of
+  arrays), the functional replacement for dynamically spawned SimPy processes.
+- ``SimMetrics``: the counters of coordsim/metrics/metrics.py:15-230 as flat
+  arrays, with the same cumulative vs per-run split (run metrics reset each
+  control interval, coordsim/writer/writer.py:222-225).
+- ``SimState``: everything that changes during an episode — flow table, per
+  (node, SF) load/availability/startup bookkeeping (the reference's
+  ``available_sf`` node attribute, simulatorparams.py:66-73), per-edge in-
+  flight data rate (``remaining_cap`` edge attribute,
+  default_forwarder.py:100-125), capacity-release ring buffers (the
+  functional analogue of the reference's delayed ``return_link_resources`` /
+  ``finish_processing`` SimPy processes), the active scheduling/placement
+  tensors and the RNG key.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# Flow phases (flow lifecycle, reference: flowsimulator.py:72-128).
+PH_FREE = 0     # slot unused
+PH_DECIDE = 1   # at a node, waiting for a next-node decision this substep
+PH_HOP = 2      # traversing an edge (timer = remaining hop delay)
+PH_PROC = 3     # processing at an SF (timer = startup wait + processing delay)
+
+# Drop reasons (metrics.py:33-38).
+DROP_TTL = 0
+DROP_DECISION = 1
+DROP_LINK_CAP = 2
+DROP_NODE_CAP = 3
+DROP_REASONS = ("TTL", "DECISION", "LINK_CAP", "NODE_CAP")
+
+
+@struct.dataclass
+class FlowTable:
+    """Preallocated flow slots [M] (reference: Flow, flow.py:10-48)."""
+
+    phase: jnp.ndarray      # [M] i32 PH_*
+    sfc: jnp.ndarray        # [M] i32
+    position: jnp.ndarray   # [M] i32 index into the SFC chain; == chain_len -> to egress
+    node: jnp.ndarray       # [M] i32 current node
+    dest: jnp.ndarray       # [M] i32 decided destination node (while forwarding)
+    hop_next: jnp.ndarray   # [M] i32 node at the end of the in-flight hop
+    egress: jnp.ndarray     # [M] i32 egress node id or -1
+    dr: jnp.ndarray         # [M] f32 data rate
+    duration: jnp.ndarray   # [M] f32 flow duration in ms (= size/dr*1000, flow.py:33)
+    ttl: jnp.ndarray        # [M] f32 remaining TTL in ms
+    e2e: jnp.ndarray        # [M] f32 accumulated end-to-end delay
+    pend_path: jnp.ndarray  # [M] f32 path delay of the in-flight path, credited on arrival
+                            #     (the reference adds the whole path delay once after the
+                            #     final hop, default_forwarder.py:83-86)
+    timer: jnp.ndarray      # [M] f32 remaining time in current phase
+
+    @property
+    def active(self) -> jnp.ndarray:
+        return self.phase != PH_FREE
+
+    @classmethod
+    def empty(cls, max_flows: int) -> "FlowTable":
+        zi = jnp.zeros(max_flows, jnp.int32)
+        zf = jnp.zeros(max_flows, jnp.float32)
+        return cls(phase=zi, sfc=zi, position=zi, node=zi, dest=zi, hop_next=zi,
+                   egress=zi - 1, dr=zf, duration=zf, ttl=zf, e2e=zf,
+                   pend_path=zf, timer=zf)
+
+
+@struct.dataclass
+class SimMetrics:
+    """Counters (reference: metrics.py:22-95).  ``run_*`` fields reset at the
+    start of every control interval (writer.py:222-225); the rest accumulate
+    over the episode."""
+
+    # cumulative
+    generated: jnp.ndarray          # [] i32 (metrics.py:'generated_flows')
+    processed: jnp.ndarray          # [] i32
+    dropped: jnp.ndarray            # [] i32
+    active: jnp.ndarray             # [] i32 ('total_active_flows')
+    drop_reasons: jnp.ndarray       # [4] i32 (TTL, DECISION, LINK_CAP, NODE_CAP)
+    sum_proc_delay: jnp.ndarray     # [] f32
+    num_proc_delay: jnp.ndarray     # [] i32
+    sum_path_delay: jnp.ndarray     # [] f32
+    num_path_delay: jnp.ndarray     # [] i32
+    sum_e2e: jnp.ndarray            # [] f32 (over processed flows)
+    # per-run
+    run_generated: jnp.ndarray      # [] i32
+    run_processed: jnp.ndarray      # [] i32
+    run_dropped: jnp.ndarray        # [] i32
+    run_dropped_per_node: jnp.ndarray   # [N] i32
+    run_e2e_sum: jnp.ndarray        # [] f32
+    run_e2e_max: jnp.ndarray        # [] f32
+    run_path_delay_sum: jnp.ndarray  # [] f32
+    run_requested: jnp.ndarray      # [N,C,S] f32 ('run_total_requested_traffic')
+    run_requested_node: jnp.ndarray  # [N] f32 (ingress-generated dr per node)
+    run_processed_traffic: jnp.ndarray  # [N,S] f32 (per node per SF)
+    run_flow_counts: jnp.ndarray    # [N,C,S,N] i32 (WRR state, metrics.py:92-95)
+    run_max_node_usage: jnp.ndarray  # [N] f32
+    run_passed_traffic: jnp.ndarray  # [E] f32 (per-edge, simulatorparams.py:249-257)
+
+    @classmethod
+    def zeros(cls, n: int, c: int, s: int, e: int) -> "SimMetrics":
+        i = lambda *shape: jnp.zeros(shape, jnp.int32)
+        f = lambda *shape: jnp.zeros(shape, jnp.float32)
+        return cls(
+            generated=i(), processed=i(), dropped=i(), active=i(),
+            drop_reasons=i(4), sum_proc_delay=f(), num_proc_delay=i(),
+            sum_path_delay=f(), num_path_delay=i(), sum_e2e=f(),
+            run_generated=i(), run_processed=i(), run_dropped=i(),
+            run_dropped_per_node=i(n), run_e2e_sum=f(), run_e2e_max=f(),
+            run_path_delay_sum=f(), run_requested=f(n, c, s),
+            run_requested_node=f(n), run_processed_traffic=f(n, s),
+            run_flow_counts=i(n, c, s, n), run_max_node_usage=f(n),
+            run_passed_traffic=f(e),
+        )
+
+    def reset_run(self) -> "SimMetrics":
+        """Per-interval reset (reference: metrics.py:64-95 reset_run_metrics,
+        fired by the writer process each run_duration, writer.py:222-225)."""
+        z = SimMetrics.zeros(self.run_dropped_per_node.shape[0],
+                             self.run_requested.shape[1],
+                             self.run_requested.shape[2],
+                             self.run_passed_traffic.shape[0])
+        return self.replace(
+            run_generated=z.run_generated, run_processed=z.run_processed,
+            run_dropped=z.run_dropped,
+            run_dropped_per_node=z.run_dropped_per_node,
+            run_e2e_sum=z.run_e2e_sum, run_e2e_max=z.run_e2e_max,
+            run_path_delay_sum=z.run_path_delay_sum,
+            run_requested=z.run_requested,
+            run_requested_node=z.run_requested_node,
+            run_processed_traffic=z.run_processed_traffic,
+            run_flow_counts=z.run_flow_counts,
+            run_max_node_usage=z.run_max_node_usage,
+            run_passed_traffic=z.run_passed_traffic,
+        )
+
+    def avg_e2e(self) -> jnp.ndarray:
+        """'avg_end2end_delay': cumulative e2e over processed flows
+        (metrics.py:203-209)."""
+        return jnp.where(self.processed > 0,
+                         self.sum_e2e / jnp.maximum(self.processed, 1), 0.0)
+
+    def run_avg_e2e(self) -> jnp.ndarray:
+        """'run_avg_end2end_delay' (metrics.py:210-215)."""
+        return jnp.where(self.run_processed > 0,
+                         self.run_e2e_sum / jnp.maximum(self.run_processed, 1), 0.0)
+
+
+@struct.dataclass
+class TrafficSchedule:
+    """Pre-generated per-episode traffic, the tensor analogue of the
+    reference's per-episode flow lists (simulatorparams.py:185-247) extended
+    to cover SFC/egress/TTL choice (default_generator.py:18-60), MMPP state
+    switching (simulatorparams.py:143-176) and trace-driven scenario changes
+    (trace_processor.py:23-54) — all host-precomputed into dense arrays.
+
+    Flow records are sorted by arrival time; the engine keeps a cursor.
+    """
+
+    arr_time: jnp.ndarray     # [F] f32, sorted ascending (inf for padding)
+    arr_ingress: jnp.ndarray  # [F] i32
+    arr_dr: jnp.ndarray       # [F] f32
+    arr_duration: jnp.ndarray  # [F] f32 (size/dr*1000)
+    arr_ttl: jnp.ndarray      # [F] f32
+    arr_sfc: jnp.ndarray      # [F] i32
+    arr_egress: jnp.ndarray   # [F] i32 (-1: none)
+    # Per control interval [T, N]: which ingresses generate flows (trace rows
+    # can deactivate an ingress, trace_processor.py:37-38; affects placement
+    # derivation via get_active_ingress_nodes, siminterface/simulator.py:261-263)
+    ingress_active: jnp.ndarray  # [T, N] bool
+    # Per control interval node capacity (traces may raise caps mid-episode,
+    # trace_processor.py:44-46); row = topology node_cap when unchanged.
+    node_cap: jnp.ndarray     # [T, N] f32
+
+    @property
+    def capacity(self) -> int:
+        return self.arr_time.shape[-1]
+
+
+@struct.dataclass
+class SimState:
+    """Complete per-episode mutable simulator state."""
+
+    t: jnp.ndarray            # [] f32 current sim time (ms)
+    run_idx: jnp.ndarray      # [] i32 control intervals completed
+    flows: FlowTable          # [M] slots
+    cursor: jnp.ndarray       # [] i32 next unconsumed traffic-schedule record
+    # per (node, SF) bookkeeping (reference 'available_sf' dicts,
+    # simulatorparams.py:66-73, duration_controller.py:46-60)
+    node_load: jnp.ndarray    # [N,S] f32 current processed load
+    sf_available: jnp.ndarray  # [N,S] bool placed or still draining
+    sf_startup: jnp.ndarray   # [N,S] f32 startup_time of the instance
+    placed: jnp.ndarray       # [N,S] bool current placement action
+    schedule: jnp.ndarray     # [N,C,S,N] f32 current scheduling weights
+    edge_used: jnp.ndarray    # [E] f32 in-flight dr per undirected edge
+    # capacity release ring buffers, indexed by substep mod horizon
+    rel_node: jnp.ndarray     # [H,N,S] f32
+    rel_edge: jnp.ndarray     # [H,E] f32
+    metrics: SimMetrics
+    rng: jnp.ndarray          # PRNG key
+    truncated_arrivals: jnp.ndarray  # [] i32 arrivals lost to slot exhaustion
+
+
+def init_state(rng, max_flows: int, n: int, c: int, s: int, e: int,
+               horizon: int) -> SimState:
+    return SimState(
+        t=jnp.zeros((), jnp.float32),
+        run_idx=jnp.zeros((), jnp.int32),
+        flows=FlowTable.empty(max_flows),
+        cursor=jnp.zeros((), jnp.int32),
+        node_load=jnp.zeros((n, s), jnp.float32),
+        sf_available=jnp.zeros((n, s), bool),
+        sf_startup=jnp.zeros((n, s), jnp.float32),
+        placed=jnp.zeros((n, s), bool),
+        schedule=jnp.zeros((n, c, s, n), jnp.float32),
+        edge_used=jnp.zeros(e, jnp.float32),
+        rel_node=jnp.zeros((horizon, n, s), jnp.float32),
+        rel_edge=jnp.zeros((horizon, e), jnp.float32),
+        metrics=SimMetrics.zeros(n, c, s, e),
+        rng=rng,
+        truncated_arrivals=jnp.zeros((), jnp.int32),
+    )

@@ -14,8 +14,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .compiler import (DEFAULT_LINK_DELAY, NetworkSpec, Topology,
-                       compile_topology, geo_delay_ms)
+from .compiler import NetworkSpec, geo_delay_ms
 
 # (label, lat, long) — public Topology Zoo Abilene city list.
 _ABILENE_CITIES = [
@@ -104,7 +103,8 @@ def random_network(n_nodes: int, avg_degree: float = 2.5,
     perm = rng.permutation(n_nodes)
     for i in range(1, n_nodes):
         add(int(perm[rng.integers(0, i)]), int(perm[i]))
-    target_edges = int(avg_degree * n_nodes / 2)
+    target_edges = min(int(avg_degree * n_nodes / 2),
+                       n_nodes * (n_nodes - 1) // 2)
     while len(edges) < target_edges:
         add(int(rng.integers(n_nodes)), int(rng.integers(n_nodes)))
     return NetworkSpec(node_caps=caps, node_types=types, edges=edges)
@@ -117,7 +117,8 @@ def mutate_caps(spec: NetworkSpec, cap_range: Tuple[int, int],
     return NetworkSpec(
         node_caps=[float(rng.integers(*cap_range)) for _ in spec.node_caps],
         node_types=list(spec.node_types), edges=list(spec.edges),
-        node_names=list(spec.node_names), coords=spec.coords)
+        node_names=list(spec.node_names),
+        coords=list(spec.coords) if spec.coords else None)
 
 
 def set_ingress(spec: NetworkSpec, nodes: Sequence[int]) -> NetworkSpec:
@@ -126,7 +127,7 @@ def set_ingress(spec: NetworkSpec, nodes: Sequence[int]) -> NetworkSpec:
              for i, t in enumerate(spec.node_types)]
     return NetworkSpec(node_caps=list(spec.node_caps), node_types=types,
                        edges=list(spec.edges), node_names=list(spec.node_names),
-                       coords=spec.coords)
+                       coords=list(spec.coords) if spec.coords else None)
 
 
 def write_graphml(spec: NetworkSpec, path: str) -> None:
