@@ -1,0 +1,17 @@
+"""RL environment layer (reference: src/rlsp/envs/)."""
+from .actions import (
+    action_mask,
+    action_to_schedule,
+    derive_placement,
+    post_process_action,
+)
+from .driver import EpisodeDriver
+from .env import EnvState, ServiceCoordEnv
+from .observations import GraphObs, flat_obs, graph_obs
+from .rewards import compute_reward, reward_constants
+
+__all__ = [
+    "action_mask", "action_to_schedule", "derive_placement",
+    "post_process_action", "EpisodeDriver", "EnvState", "ServiceCoordEnv",
+    "GraphObs", "flat_obs", "graph_obs", "compute_reward", "reward_constants",
+]

@@ -1,0 +1,82 @@
+"""Action post-processing and placement derivation — pure jnp, vmap-able.
+
+The reference post-processes actor outputs on the host per N-destination row
+(threshold + renormalize, applied twice — src/rlsp/agents/simple_ddpg.py:374-395
+with normalize semantics of common/common_functionalities.py:12-55) and derives
+the placement by recursively following nonzero schedule weights from every
+active ingress (src/rlsp/envs/simulator_wrapper.py:90-120, 161-167).  Both are
+reimplemented as fixed-shape tensor ops that jit/vmap.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def post_process_action(action: jnp.ndarray, num_dst: int,
+                        threshold: float = 0.1) -> jnp.ndarray:
+    """Threshold low probabilities to zero and renormalize each destination
+    row to sum 1, twice (simple_ddpg.py:381-388).
+
+    An all-zero row becomes the uniform distribution over all ``num_dst``
+    (padded) destinations, matching normalize_scheduling_probabilities'
+    zero-sum branch (common_functionalities.py:30-32) — the second threshold
+    pass then zeroes 1/num_dst again whenever 1/num_dst < threshold, so the
+    fixed point is uniform, exactly as in the reference.
+
+    action: [..., R * num_dst] flat scheduling tensor in [0, 1].
+    """
+    shape = action.shape
+    rows = action.reshape(shape[:-1] + (-1, num_dst))
+    for _ in range(2):
+        kept = jnp.where(rows >= threshold, rows, 0.0)
+        total = kept.sum(-1, keepdims=True)
+        rows = jnp.where(total > 0, kept / jnp.maximum(total, 1e-30),
+                         1.0 / num_dst)
+    return rows.reshape(shape)
+
+
+def action_to_schedule(action: jnp.ndarray, scheduling_shape) -> jnp.ndarray:
+    """Flat action [A] -> dense schedule [N, C, S, N] (the reference's
+    reshape at simulator_wrapper.py:145-146; no dict explosion needed)."""
+    return action.reshape(scheduling_shape)
+
+
+def derive_placement(schedule: jnp.ndarray, chain_sf: np.ndarray,
+                     chain_len: np.ndarray, active_ingress: jnp.ndarray,
+                     num_sfs: int) -> jnp.ndarray:
+    """Reachability-based placement [N, S] from schedule weights.
+
+    The tensor equivalent of add_placement_recursive
+    (simulator_wrapper.py:90-120): starting from every active ingress, a node
+    hosts SF ``chain_sf[c, s]`` iff any reachable source schedules nonzero
+    weight to it at chain position ``s``; reachability then advances to those
+    targets.  The recursion depth is the (static) chain length, so this is a
+    short unrolled loop of [N]x[N,N] reductions.
+
+    schedule:       [N, C, S, N] scheduling weights
+    chain_sf:       [C, S] static np array of SF indices (-1 pad)
+    chain_len:      [C] static np array
+    active_ingress: [N] bool (get_active_ingress_nodes,
+                    siminterface/simulator.py:261-263)
+    """
+    n = schedule.shape[0]
+    placed = jnp.zeros((n, num_sfs), bool)
+    for c in range(chain_sf.shape[0]):
+        reach = active_ingress
+        for s in range(int(chain_len[c])):
+            targets = ((schedule[:, c, s, :] > 0) & reach[:, None]).any(axis=0)
+            placed = placed.at[:, int(chain_sf[c, s])].max(targets)
+            reach = targets
+    return placed
+
+
+def action_mask(node_mask: jnp.ndarray, num_sfcs: int,
+                max_sfs: int) -> jnp.ndarray:
+    """Flattened [N*C*S*N] 0/1 mask selecting (real src, *, *, real dst)
+    entries (the wrapper's mask at simulator_wrapper.py:139-143; also the
+    ``mask`` attached to graph observations, simulator_wrapper.py:300-305)."""
+    m = node_mask.astype(jnp.float32)
+    mask4 = m[:, None, None, None] * m[None, None, None, :]
+    return jnp.broadcast_to(
+        mask4, (m.shape[0], num_sfcs, max_sfs, m.shape[0])).reshape(-1)

@@ -1,0 +1,77 @@
+"""Host-side episode driver: topology scheduling + per-episode traffic.
+
+The reference swaps the training topology every ``period`` episodes (cycling
+the scheduler's ``training_network_files``) and always uses the inference
+network in test mode (src/rlsp/envs/gym_env.py:103-128, configs/config/
+scheduler.yaml:1-11), regenerating pre-sampled flow lists each episode
+(siminterface/simulator.py:115-117).  Here both become cheap host-side array
+selection: topologies are compiled once into padded ``Topology`` pytrees, and
+each episode gets a freshly sampled ``TrafficSchedule``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config.schema import SchedulerConfig, ServiceConfig, SimConfig
+from ..sim.state import TrafficSchedule
+from ..sim.traffic import TraceEvents, generate_traffic, traffic_capacity
+from ..topology.compiler import Topology, load_topology
+
+
+class EpisodeDriver:
+    """Yields (topology, traffic) per episode following the scheduler config."""
+
+    def __init__(self, scheduler: SchedulerConfig, sim_cfg: SimConfig,
+                 service: ServiceConfig, episode_steps: int,
+                 max_nodes: int = 24, max_edges: int = 37,
+                 base_seed: int = 0,
+                 topologies: Optional[Sequence[Topology]] = None,
+                 inference_topology: Optional[Topology] = None):
+        self.scheduler = scheduler
+        self.sim_cfg = sim_cfg
+        self.service = service
+        self.episode_steps = episode_steps
+        self.base_seed = base_seed
+        if topologies is None:
+            topologies = [
+                load_topology(p, max_nodes=max_nodes, max_edges=max_edges,
+                              force_link_cap=sim_cfg.force_link_cap,
+                              force_node_cap=sim_cfg.force_node_cap,
+                              seed=base_seed)
+                for p in scheduler.training_network_files
+            ]
+        self.topologies: List[Topology] = list(topologies)
+        if inference_topology is None:
+            inference_topology = load_topology(
+                scheduler.inference_network, max_nodes=max_nodes,
+                max_edges=max_edges, force_link_cap=sim_cfg.force_link_cap,
+                force_node_cap=sim_cfg.force_node_cap, seed=base_seed)
+        self.inference_topology = inference_topology
+        self.trace = (TraceEvents.from_csv(sim_cfg.trace_path, int)
+                      if sim_cfg.trace_path else None)
+        # fixed traffic capacity across episodes -> no recompiles
+        max_ing = max(int(np.asarray(t.is_ingress).sum()) for t in
+                      self.topologies + [self.inference_topology])
+        self.capacity = traffic_capacity(sim_cfg, max_ing, episode_steps)
+
+    def topology_for(self, episode: int, test_mode: bool = False) -> Topology:
+        """Topology schedule (gym_env.py:103-128): switch every ``period``
+        episodes, cycling the training list; inference net in test mode."""
+        if test_mode:
+            return self.inference_topology
+        index = (episode // self.scheduler.period) % len(self.topologies)
+        return self.topologies[index]
+
+    def traffic_for(self, episode: int, topo: Topology,
+                    seed: Optional[int] = None) -> TrafficSchedule:
+        seed = self.base_seed + episode if seed is None else seed
+        return generate_traffic(self.sim_cfg, self.service, topo,
+                                self.episode_steps, seed, trace=self.trace,
+                                capacity=self.capacity)
+
+    def episode(self, episode: int, test_mode: bool = False,
+                seed: Optional[int] = None):
+        topo = self.topology_for(episode, test_mode)
+        return topo, self.traffic_for(episode, topo, seed)

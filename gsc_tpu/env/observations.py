@@ -1,0 +1,95 @@
+"""Observation builders — flat and graph modes, pure jnp.
+
+Reference: src/rlsp/envs/simulator_wrapper.py:178-308.  Three node-feature
+vectors, each max-normalized as ``clip(x / (max(x) + 1e-3), 0, 1)``:
+
+- ``ingress_traffic``: per-node requested traffic of each chain's *first* SF
+  (simulator_wrapper.py:205-212, 255-266).  The reference iterates SFCs and
+  lets the last one win the dict write; we sum across SFCs (identical for the
+  default single-SFC catalog; documented divergence for multi-SFC).
+- ``node_load``: processed-traffic / node-capacity utilization, 1 where the
+  node has zero capacity (simulator_wrapper.py:196-203, 268-281).
+- ``node_cap``: max-normalized raw capacity (simulator_wrapper.py:216-221,
+  283-292).
+
+Flat mode concatenates the selected vectors (simulator_wrapper.py:223-230);
+the reference sizes them by the *real* node count — here they are padded to
+MAX_NODES with zeros so shapes stay static.  Graph mode returns the node
+feature matrix + directed edge index + the flattened action mask, the pytree
+analogue of the torch-geometric ``Data`` (simulator_wrapper.py:294-308).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..topology.compiler import Topology
+from .actions import action_mask
+
+
+@struct.dataclass
+class GraphObs:
+    """Graph observation (reference: torch-geometric Data with x, edge_index,
+    mask — simulator_wrapper.py:294-308)."""
+
+    nodes: jnp.ndarray       # [N, F] node features
+    node_mask: jnp.ndarray   # [N] bool (padding made explicit)
+    edge_index: jnp.ndarray  # [2, 2E] directed (both ways per undirected edge)
+    edge_mask: jnp.ndarray   # [2E] bool
+    mask: jnp.ndarray        # [A] flattened action mask
+
+
+def _maxnorm(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.clip(x / (jnp.max(x) + 1e-3), 0.0, 1.0)
+
+
+def node_features(metrics, topo: Topology, node_cap_now: jnp.ndarray,
+                  chain_sf: np.ndarray, observation_space: Tuple[str, ...]
+                  ) -> jnp.ndarray:
+    """[N, F] feature matrix with F = len(observation_space), columns in the
+    configured order (sample_agent.yaml:6-9)."""
+    cols = []
+    for comp in observation_space:
+        if comp == "ingress_traffic":
+            ing = jnp.zeros_like(node_cap_now)
+            for c in range(chain_sf.shape[0]):
+                ing = ing + metrics.run_requested[:, c, int(chain_sf[c, 0])]
+            cols.append(_maxnorm(ing))
+        elif comp == "node_load":
+            usage = metrics.run_processed_traffic.sum(axis=-1)
+            util = jnp.where(node_cap_now > 0, usage / jnp.maximum(node_cap_now, 1e-30), 1.0)
+            util = jnp.where(topo.node_mask, util, 0.0)
+            cols.append(_maxnorm(util))
+        elif comp == "node_cap":
+            cols.append(_maxnorm(jnp.where(topo.node_mask, node_cap_now, 0.0)))
+        else:  # validated at config load; defensive
+            raise ValueError(f"Unknown observation component {comp!r}")
+    return jnp.stack(cols, axis=-1)
+
+
+def flat_obs(metrics, topo: Topology, node_cap_now: jnp.ndarray,
+             chain_sf: np.ndarray, observation_space: Tuple[str, ...]
+             ) -> jnp.ndarray:
+    """[N * F] concatenation of the selected vectors
+    (simulator_wrapper.py:223-230)."""
+    feats = node_features(metrics, topo, node_cap_now, chain_sf,
+                          observation_space)
+    return feats.T.reshape(-1)
+
+
+def graph_obs(metrics, topo: Topology, node_cap_now: jnp.ndarray,
+              chain_sf: np.ndarray, observation_space: Tuple[str, ...],
+              num_sfcs: int, max_sfs: int) -> GraphObs:
+    feats = node_features(metrics, topo, node_cap_now, chain_sf,
+                          observation_space)
+    edge_index, edge_mask = topo.directed_edge_index()
+    return GraphObs(
+        nodes=jnp.where(topo.node_mask[:, None], feats, 0.0),
+        node_mask=topo.node_mask,
+        edge_index=edge_index,
+        edge_mask=edge_mask,
+        mask=action_mask(topo.node_mask, num_sfcs, max_sfs),
+    )

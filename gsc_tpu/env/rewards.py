@@ -1,0 +1,117 @@
+"""Reward objectives — pure jnp, vmap-able.
+
+Reference: src/rlsp/envs/gym_env.py:223-380.  Four objectives
+(src/rlsp/utils/constants.py:3):
+
+- ``prio-flow``: flow reward first; delay only counts once the success ratio
+  meets the target (or 0.9x the EWMA of past success when target='auto',
+  gym_env.py:310-323 with EWMA update at gym_env.py:83-91).
+- ``soft-deadline``: meet the delay deadline first, then optimize flow
+  success with the delay term frozen (gym_env.py:325-334).
+- ``soft-deadline-exp``: utility U = succ_ratio * U_d(delay) with
+  log-exponential dropoff past the deadline (gym_env.py:336-355).
+- ``weighted``: configured linear combination of all four components
+  (gym_env.py:357-362).
+
+Components (all in [-1, 1]):
+- flow reward (succ - drop)/(succ + drop) over the last control interval
+  (gym_env.py:223-234)
+- delay reward 1 + (min_delay - delay)/diameter, clipped; -1 when no flow
+  succeeded (gym_env.py:236-250); min_delay = sum of VNF processing means
+  (gym_env.py:93-101); diameter hard-coded 15 (gym_env.py:56)
+- shaped node reward counting a node as 0.5..1 used by its placed-SF count
+  (gym_env.py:268-285)
+- instance reward by total placed instances (gym_env.py:287-298)
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..config.schema import AgentConfig
+
+
+def reward_constants(agent: AgentConfig, proc_delay_means) -> Tuple[float, float]:
+    """(min_delay, network_diameter).  min_delay = sum of VNF delay means
+    (gym_env.py:93-101); the diameter is the reference's hard-coded 15
+    (gym_env.py:56)."""
+    return float(sum(proc_delay_means)), 15.0
+
+
+def compute_reward(agent: AgentConfig, metrics, placement: jnp.ndarray,
+                   node_mask: jnp.ndarray, num_sfs: int, min_delay: float,
+                   diameter: float, ewma_flows: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """-> (total_reward, new_ewma_flows, info).
+
+    placement: the *derived* [N, S] placement (only SFs reachable by traffic),
+    which is what the reference's simulator state reports back
+    (simulator_wrapper.py:161-167 -> siminterface/simulator.py sf_placement).
+    """
+    succ = metrics.run_processed.astype(jnp.float32)
+    drop = metrics.run_dropped.astype(jnp.float32)
+    total = succ + drop
+    succ_ratio = jnp.where(total > 0, succ / jnp.maximum(total, 1.0), 0.0)
+    flow_reward = jnp.where(total > 0, (succ - drop) / jnp.maximum(total, 1.0), 0.0)
+
+    delay = jnp.maximum(metrics.run_avg_e2e(), min_delay)
+    delay_reward = jnp.clip((min_delay - delay) / diameter + 1.0, -1.0, 1.0)
+    delay_reward = jnp.where(succ_ratio == 0, -1.0, delay_reward)
+
+    # shaped node usage: 0.5 + 0.5 * (k-1)/(num_sfs-1) per node with k>=1
+    # placed SFs (gym_env.py:268-285)
+    num_nodes = node_mask.sum().astype(jnp.float32)
+    k = placement.astype(jnp.float32).sum(axis=-1)
+    frac = jnp.where(
+        k > 0, 0.5 + 0.5 * (k - 1.0) / jnp.maximum(num_sfs - 1.0, 1.0), 0.0)
+    nodes_used = jnp.where(node_mask, frac, 0.0).sum()
+    nodes_reward = 2.0 * (-nodes_used / jnp.maximum(num_nodes, 1.0)) + 1.0
+
+    num_instances = placement.astype(jnp.float32).sum()
+    instance_reward = 2.0 * (-num_instances / jnp.maximum(num_nodes * num_sfs, 1.0)) + 1.0
+
+    new_ewma = ewma_flows
+    if agent.objective == "prio-flow":
+        nodes_reward = jnp.zeros(())
+        instance_reward = jnp.zeros(())
+        if agent.target_success == "auto":
+            target = 0.9 * ewma_flows
+            new_ewma = 0.5 * succ_ratio + 0.5 * ewma_flows  # gym_env.py:83-91
+        else:
+            target = jnp.asarray(float(agent.target_success))
+        delay_reward = jnp.where(succ_ratio < target, -1.0, delay_reward)
+    elif agent.objective == "soft-deadline":
+        nodes_reward = jnp.zeros(())
+        instance_reward = jnp.zeros(())
+        met = delay <= agent.soft_deadline
+        flow_reward = jnp.where(met, flow_reward, -1.0)
+        delay_reward = jnp.where(
+            met, jnp.clip(-agent.soft_deadline / diameter, -1.0, 1.0),
+            delay_reward)
+    elif agent.objective == "soft-deadline-exp":
+        flow_reward = jnp.zeros(())
+        nodes_reward = jnp.zeros(())
+        instance_reward = jnp.zeros(())
+        over = jnp.maximum(delay - agent.soft_deadline, 1e-30)
+        delay_utility = jnp.where(
+            delay > agent.soft_deadline,
+            jnp.clip(-jnp.log10(over / agent.dropoff), 0.0, 1.0), 1.0)
+        delay_reward = succ_ratio * delay_utility
+    elif agent.objective == "weighted":
+        flow_reward = flow_reward * agent.flow_weight
+        delay_reward = delay_reward * agent.delay_weight
+        nodes_reward = nodes_reward * agent.node_weight
+        instance_reward = instance_reward * agent.instance_weight
+    # objective validity enforced at config load (schema.py)
+
+    total_reward = flow_reward + delay_reward + nodes_reward + instance_reward
+    info = {
+        "succ_ratio": succ_ratio,
+        "avg_e2e_delay": delay,
+        "flow_reward": flow_reward,
+        "delay_reward": delay_reward,
+        "nodes_reward": nodes_reward,
+        "instance_reward": instance_reward,
+    }
+    return total_reward, new_ewma, info
