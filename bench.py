@@ -1,0 +1,81 @@
+"""Benchmark: env-steps/sec/chip on the Abilene flagship scenario.
+
+Measures the full training loop — vmapped env-replica rollout (simulator
+physics + obs + reward on device) and the end-of-episode DDPG learn burst —
+on one chip, and prints ONE JSON line:
+
+    {"metric": "env_steps_per_sec_per_chip", "value": ..., "unit": ...,
+     "vs_baseline": ...}
+
+Baseline: the reference publishes no numbers (BASELINE.md); its training loop
+is a single SimPy env + torch-geometric DDPG on one CPU core, whose
+steps/sec it logs to TensorBoard but never reports.  We use
+REFERENCE_CPU_SPS = 100 env-steps/sec as a generous order-of-magnitude
+estimate of that loop (each step simulates ~1000 SimPy events plus a GNN
+forward; the paper's training runs are hours for ~40k steps).
+``vs_baseline`` is measured_value / REFERENCE_CPU_SPS.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_CPU_SPS = 100.0
+REPLICAS = 256
+EPISODE_STEPS = 200
+EPISODES_MEASURED = 3
+
+
+def main():
+    from __graft_entry__ import _flagship
+    from gsc_tpu.parallel import ParallelDDPG
+
+    env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
+    from gsc_tpu.sim.traffic import generate_traffic
+
+    B = REPLICAS
+    traffic = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[generate_traffic(env.sim_cfg, env.service, topo, EPISODE_STEPS,
+                           seed=s) for s in range(B)])
+    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+
+    def episode(state, buffers, env_states, obs, start_step):
+        state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+            state, buffers, env_states, obs, topo, traffic,
+            jnp.int32(start_step))
+        state, metrics = pddpg.learn_burst(state, buffers)
+        return state, buffers, env_states, obs, stats, metrics
+
+    # warmup/compile
+    out = episode(state, buffers, env_states, obs, 0)
+    jax.block_until_ready(out)
+    state, buffers, env_states, obs = out[:4]
+
+    t0 = time.time()
+    for ep in range(1, 1 + EPISODES_MEASURED):
+        out = episode(state, buffers, env_states, obs, ep * EPISODE_STEPS)
+        jax.block_until_ready(out)
+        state, buffers, env_states, obs = out[:4]
+    dt = time.time() - t0
+
+    env_steps = EPISODES_MEASURED * EPISODE_STEPS * B
+    sps = env_steps / dt
+    print(json.dumps({
+        "metric": "env_steps_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "env-steps/s",
+        "vs_baseline": round(sps / REFERENCE_CPU_SPS, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

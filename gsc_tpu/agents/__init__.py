@@ -1,0 +1,7 @@
+"""Learning agents (reference: src/rlsp/agents/)."""
+from .buffer import ReplayBuffer, buffer_add, buffer_init, buffer_sample
+from .ddpg import DDPG, DDPGState
+from .trainer import Trainer
+
+__all__ = ["ReplayBuffer", "buffer_add", "buffer_init", "buffer_sample",
+           "DDPG", "DDPGState", "Trainer"]

@@ -1,0 +1,56 @@
+"""On-device replay buffer — generic pytree ring buffer in HBM.
+
+The reference's GraphReplayBuffer stores torch-geometric ``Data`` objects in
+a numpy *object* array and re-batches them on every sample
+(src/rlsp/agents/buffer.py:16-89) — host memory, pointer chasing, CPU
+collation.  Here observations are already fixed-shape pytrees (GraphObs or
+flat vectors), so the whole buffer is a pytree with a leading [capacity]
+axis resident in device memory: ``add`` is a dynamic-index scatter, ``sample``
+a gather — both jit/scan-able, so rollout and learning never leave the
+device.  Works for any transition pytree (graph obs store nodes, edge_index,
+masks per transition, which also preserves cross-topology replay when the
+topology schedule swaps networks mid-training).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class ReplayBuffer:
+    """Ring buffer (reference: buffer.py:16-54 ring semantics)."""
+
+    data: Any                # pytree, each leaf [capacity, ...]
+    pos: jnp.ndarray         # [] i32 next write slot
+    size: jnp.ndarray       # [] i32 valid entries
+
+
+def buffer_init(example: Any, capacity: int) -> ReplayBuffer:
+    """Allocate from an example transition pytree (shapes/dtypes copied)."""
+    data = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((capacity,) + jnp.shape(x), jnp.asarray(x).dtype),
+        example)
+    return ReplayBuffer(data=data, pos=jnp.zeros((), jnp.int32),
+                        size=jnp.zeros((), jnp.int32))
+
+
+def buffer_add(buf: ReplayBuffer, item: Any) -> ReplayBuffer:
+    """Insert one transition (buffer.py:33-54)."""
+    capacity = jax.tree_util.tree_leaves(buf.data)[0].shape[0]
+    data = jax.tree_util.tree_map(
+        lambda d, x: jax.lax.dynamic_update_index_in_dim(
+            d, jnp.asarray(x).astype(d.dtype), buf.pos, 0),
+        buf.data, item)
+    return ReplayBuffer(data=data, pos=(buf.pos + 1) % capacity,
+                        size=jnp.minimum(buf.size + 1, capacity))
+
+
+def buffer_sample(buf: ReplayBuffer, key, batch_size: int) -> Any:
+    """Uniform sample of ``batch_size`` transitions (buffer.py:56-67)."""
+    idx = jax.random.randint(key, (batch_size,), 0,
+                             jnp.maximum(buf.size, 1))
+    return jax.tree_util.tree_map(lambda d: d[idx], buf.data)

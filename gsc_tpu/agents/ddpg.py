@@ -1,0 +1,230 @@
+"""DDPG learner — jitted rollout + learn-burst (reference:
+src/rlsp/agents/simple_ddpg.py:101-329).
+
+CleanRL-style DDPG: one actor, one critic, Polyak-averaged targets, Adam.
+The reference steps the env and nets one Python call at a time on CPU; here
+a whole episode's rollout is one ``lax.scan`` (actions, env physics, replay
+writes all on device) and the end-of-episode learning burst is one
+``lax.fori_loop`` of ``episode_steps`` gradient steps (simple_ddpg.py:307-325)
+— two device calls per episode in total.
+
+Faithful semantics:
+- warmup (< nb_steps_warmup_critic global steps): uniform random action
+  masked to valid entries (simple_ddpg.py:184-187)
+- after warmup: actor output scaled to [-1,1], Gaussian noise
+  N(rand_mu, rand_sigma) added, unscaled back and clipped to [0,1]
+  (simple_ddpg.py:188-201; the reference's `.clip(-1,1)` on the scaled
+  action is a no-op it discards — not reproduced)
+- post-processing threshold+renormalize before the env sees the action
+  (simple_ddpg.py:248-249)
+- critic target: r + gamma * (1 - done) * Q_target(s', clamp(pi_target(s'), -1, 1))
+  (simple_ddpg.py:207-214)
+- actor loss: -Q(s, pi(s)).mean() (simple_ddpg.py:221-227)
+- Polyak tau = target_model_update each gradient step (simple_ddpg.py:229-234)
+- train once per episode end: episode_steps gradient steps on batches of
+  batch_size (simple_ddpg.py:300-325)
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from ..config.schema import AgentConfig
+from ..env.env import ServiceCoordEnv
+from ..models.nets import Actor, QNetwork, scale_action, unscale_action
+from .buffer import ReplayBuffer, buffer_add, buffer_init, buffer_sample
+
+
+@struct.dataclass
+class DDPGState:
+    """Learner state (networks, targets, optimizers, PRNG)."""
+
+    actor_params: Any
+    critic_params: Any
+    target_actor_params: Any
+    target_critic_params: Any
+    actor_opt: Any
+    critic_opt: Any
+    rng: jnp.ndarray
+
+
+class DDPG:
+    """Factory closing over static config; all methods are pure and jitted."""
+
+    def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
+                 gnn_impl: str = "dense"):
+        self.env = env
+        self.agent = agent
+        self.action_dim = env.limits.action_dim
+        self.actor = Actor(agent=agent, action_dim=self.action_dim,
+                           gnn_impl=gnn_impl)
+        self.critic = QNetwork(agent=agent, gnn_impl=gnn_impl)
+        self.opt = optax.adam(agent.learning_rate)
+
+    # ---------------------------------------------------------------- init
+    def init(self, rng, sample_obs) -> DDPGState:
+        k1, k2, k3 = jax.random.split(rng, 3)
+        actor_params = self.actor.init(k1, sample_obs)
+        critic_params = self.critic.init(
+            k2, sample_obs, jnp.zeros(self.action_dim))
+        return DDPGState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_actor_params=actor_params,
+            target_critic_params=critic_params,
+            actor_opt=self.opt.init(actor_params),
+            critic_opt=self.opt.init(critic_params),
+            rng=k3,
+        )
+
+    def example_transition(self, sample_obs):
+        """Shape/dtype template of one replay transition."""
+        return {
+            "obs": sample_obs,
+            "next_obs": sample_obs,
+            "action": jnp.zeros(self.action_dim),
+            "reward": jnp.zeros(()),
+            "done": jnp.zeros(()),
+        }
+
+    def init_buffer(self, sample_obs) -> ReplayBuffer:
+        return buffer_init(self.example_transition(sample_obs),
+                           self.agent.mem_limit)
+
+    # ------------------------------------------------------------- actions
+    def choose_action(self, actor_params, obs, mask, global_step, key):
+        """Warmup random masked action, else actor + Gaussian noise in scaled
+        space (simple_ddpg.py:182-201)."""
+        k1, k2 = jax.random.split(key)
+        random_action = jax.random.uniform(k1, (self.action_dim,)) * mask
+
+        def policy_action():
+            a = self.actor.apply(actor_params, obs)
+            scaled = scale_action(a)
+            noise = self.agent.rand_mu + self.agent.rand_sigma * \
+                jax.random.normal(k2, (self.action_dim,))
+            return jnp.clip(unscale_action(scaled + noise), 0.0, 1.0)
+
+        warmup = global_step < self.agent.nb_steps_warmup_critic
+        return jax.lax.cond(warmup, lambda: random_action, policy_action)
+
+    # ------------------------------------------------------------- rollout
+    @partial(jax.jit, static_argnums=0)
+    def rollout_episode(self, state: DDPGState, buffer: ReplayBuffer,
+                        env_state, obs, topo, traffic,
+                        episode_start_step: jnp.ndarray
+                        ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
+                                   Dict[str, jnp.ndarray]]:
+        """One full episode as a lax.scan: action -> env.step -> buffer.add.
+        Returns (state w/ fresh rng, buffer, final_env_state, final_obs,
+        episode stats)."""
+        from ..env.actions import action_mask
+        mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
+                           self.env.limits.max_sfs)
+        rng, sub = jax.random.split(state.rng)
+
+        def step_fn(carry, i):
+            env_state, obs, buffer = carry
+            k = jax.random.fold_in(sub, i)
+            action = self.choose_action(state.actor_params, obs, mask,
+                                        episode_start_step + i, k)
+            action = self.env.process_action(action)
+            env_state, next_obs, reward, done, info = self.env.step(
+                env_state, topo, traffic, action)
+            buffer = buffer_add(buffer, {
+                "obs": obs, "next_obs": next_obs, "action": action,
+                "reward": reward, "done": done.astype(jnp.float32),
+            })
+            stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
+                     "avg_e2e_delay": info["avg_e2e_delay"]}
+            return (env_state, next_obs, buffer), stats
+
+        (env_state, obs, buffer), stats = jax.lax.scan(
+            step_fn, (env_state, obs, buffer),
+            jnp.arange(self.agent.episode_steps))
+        episode_stats = {
+            "episodic_return": stats["reward"].sum(),
+            "mean_succ_ratio": stats["succ_ratio"].mean(),
+            "mean_e2e_delay": stats["avg_e2e_delay"].mean(),
+            "final_succ_ratio": stats["succ_ratio"][-1],
+        }
+        return state.replace(rng=rng), buffer, env_state, obs, episode_stats
+
+    # ------------------------------------------------------------ learning
+    def _critic_loss(self, critic_params, state: DDPGState, batch):
+        next_a = jnp.clip(
+            self.actor.apply(state.target_actor_params, batch["next_obs"]),
+            -1.0, 1.0)  # clamp(-1,1), simple_ddpg.py:208
+        q_next = self.critic.apply(state.target_critic_params,
+                                   batch["next_obs"], next_a)[..., 0]
+        target = batch["reward"] + (1.0 - batch["done"]) * self.agent.gamma * q_next
+        q = self.critic.apply(critic_params, batch["obs"], batch["action"])[..., 0]
+        return jnp.mean((q - jax.lax.stop_gradient(target)) ** 2), q
+
+    def _actor_loss(self, actor_params, critic_params, batch):
+        a = self.actor.apply(actor_params, batch["obs"])
+        return -jnp.mean(self.critic.apply(critic_params, batch["obs"], a))
+
+    def gradient_step(self, state: DDPGState, buffer: ReplayBuffer, key
+                      ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
+        """One (critic, actor, Polyak) update on a sampled batch
+        (simple_ddpg.py:204-234, 307-325)."""
+        batch = buffer_sample(buffer, key, self.agent.batch_size)
+        return self.gradient_step_on_batch(state, batch)
+
+    def gradient_step_on_batch(self, state: DDPGState, batch
+                               ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
+        (critic_loss, q_vals), cgrad = jax.value_and_grad(
+            self._critic_loss, has_aux=True)(state.critic_params, state, batch)
+        cupd, critic_opt = self.opt.update(cgrad, state.critic_opt)
+        critic_params = optax.apply_updates(state.critic_params, cupd)
+
+        actor_loss, agrad = jax.value_and_grad(self._actor_loss)(
+            state.actor_params, critic_params, batch)
+        aupd, actor_opt = self.opt.update(agrad, state.actor_opt)
+        actor_params = optax.apply_updates(state.actor_params, aupd)
+
+        tau = self.agent.target_model_update
+        polyak = lambda t, p: jax.tree_util.tree_map(
+            lambda tl, pl: tau * pl + (1 - tau) * tl, t, p)
+        state = DDPGState(
+            actor_params=actor_params, critic_params=critic_params,
+            target_actor_params=polyak(state.target_actor_params, actor_params),
+            target_critic_params=polyak(state.target_critic_params,
+                                        critic_params),
+            actor_opt=actor_opt, critic_opt=critic_opt, rng=state.rng)
+        metrics = {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                   "q_values": q_vals.mean()}
+        return state, metrics
+
+    def _learn_burst(self, state: DDPGState, sample_fn
+                     ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
+        """End-of-episode training: episode_steps gradient steps
+        (simple_ddpg.py:307-325) as one fori_loop.  ``sample_fn(key)``
+        yields a batch — single-buffer and cross-replica samplers both
+        plug in here."""
+        rng, sub = jax.random.split(state.rng)
+        state = state.replace(rng=sub)
+
+        def body(i, carry):
+            st, _ = carry
+            batch = sample_fn(jax.random.fold_in(sub, i))
+            st, metrics = self.gradient_step_on_batch(st, batch)
+            return st, metrics
+
+        zero = {"critic_loss": jnp.zeros(()), "actor_loss": jnp.zeros(()),
+                "q_values": jnp.zeros(())}
+        state, metrics = jax.lax.fori_loop(
+            0, self.agent.episode_steps, body, (state, zero))
+        return state.replace(rng=rng), metrics
+
+    @partial(jax.jit, static_argnums=0)
+    def learn_burst(self, state: DDPGState, buffer: ReplayBuffer
+                    ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
+        return self._learn_burst(
+            state, lambda k: buffer_sample(buffer, k, self.agent.batch_size))

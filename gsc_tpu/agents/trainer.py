@@ -1,0 +1,152 @@
+"""Training driver — the host loop around the jitted rollout/learn kernels.
+
+The analogue of SimpleDDPG.train + the experiment plumbing of
+src/rlsp/agents/main.py: per episode it picks the scheduled topology,
+samples traffic (host), then issues exactly two device calls — a full-episode
+rollout scan and a learn burst — and logs episode metrics (rewards.csv like
+result_writer.py:6-38, optional TensorBoard like simple_ddpg.py:165-174).
+"""
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..config.schema import AgentConfig
+from ..env.driver import EpisodeDriver
+from ..env.env import ServiceCoordEnv
+from .ddpg import DDPG, DDPGState
+
+
+class RewardsWriter:
+    """rewards.csv with the live writer's schema (result_writer.py:23: field
+    'r')."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._file = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._file = open(path, "w", newline="")
+            self._csv = csv.DictWriter(self._file, fieldnames=["r"])
+            self._csv.writeheader()
+
+    def write(self, reward: float):
+        if self._file:
+            self._csv.writerow({"r": reward})
+            self._file.flush()
+
+    def close(self):
+        if self._file:
+            self._file.close()
+
+
+class Trainer:
+    def __init__(self, env: ServiceCoordEnv, driver: EpisodeDriver,
+                 agent_cfg: AgentConfig, seed: int = 0,
+                 result_dir: Optional[str] = None,
+                 tensorboard: bool = False, gnn_impl: str = "dense"):
+        self.env = env
+        self.driver = driver
+        self.agent_cfg = agent_cfg
+        self.seed = seed
+        self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl)
+        self.result_dir = result_dir
+        self.rewards_writer = RewardsWriter(
+            os.path.join(result_dir, "rewards.csv") if result_dir else None)
+        self.tb = None
+        if tensorboard and result_dir:
+            try:  # torch's TB writer, mirroring simple_ddpg.py:165
+                from torch.utils.tensorboard import SummaryWriter
+                self.tb = SummaryWriter(os.path.join(result_dir, "tb"))
+            except ImportError:
+                pass
+        self.history: List[Dict[str, float]] = []
+
+    def _log(self, episode: int, global_step: int, stats, learn_metrics,
+             sps: float):
+        row = {k: float(np.asarray(v)) for k, v in stats.items()}
+        if learn_metrics is not None:
+            row.update({k: float(np.asarray(v))
+                        for k, v in learn_metrics.items()})
+        row.update(episode=episode, sps=sps)
+        self.history.append(row)
+        self.rewards_writer.write(row["episodic_return"])
+        if self.tb:
+            self.tb.add_scalar("charts/episodic_return",
+                               row["episodic_return"], global_step)
+            self.tb.add_scalar("charts/SPS", sps, global_step)
+            if learn_metrics is not None:
+                self.tb.add_scalar("losses/qf1_loss", row["critic_loss"],
+                                   global_step)
+                self.tb.add_scalar("losses/actor_loss", row["actor_loss"],
+                                   global_step)
+                self.tb.add_scalar("losses/qf1_values", row["q_values"],
+                                   global_step)
+
+    def train(self, episodes: int, test_mode: bool = False,
+              verbose: bool = False) -> DDPGState:
+        """Train for ``episodes`` episodes (train-at-episode-end schedule,
+        simple_ddpg.py:280-329).  Returns the final learner state."""
+        rng = jax.random.PRNGKey(self.seed)
+        steps_per_ep = self.agent_cfg.episode_steps
+
+        topo, traffic = self.driver.episode(0, test_mode)
+        rng, k_env, k_agent = jax.random.split(rng, 3)
+        env_state, obs = self.env.reset(k_env, topo, traffic)
+        state = self.ddpg.init(k_agent, obs)
+        buffer = self.ddpg.init_buffer(obs)
+
+        start = time.time()
+        for ep in range(episodes):
+            if ep > 0:
+                topo, traffic = self.driver.episode(ep, test_mode)
+                rng, k_env = jax.random.split(rng)
+                env_state, obs = self.env.reset(k_env, topo, traffic)
+            global_step = ep * steps_per_ep
+            state, buffer, env_state, obs, stats = self.ddpg.rollout_episode(
+                state, buffer, env_state, obs, topo, traffic,
+                np.int32(global_step))
+            learn_metrics = None
+            end_step = global_step + steps_per_ep - 1
+            if end_step >= self.agent_cfg.nb_steps_warmup_critic - 1:
+                state, learn_metrics = self.ddpg.learn_burst(state, buffer)
+            sps = (ep + 1) * steps_per_ep / (time.time() - start)
+            self._log(ep, end_step, stats, learn_metrics, sps)
+            if verbose:
+                print(f"episode={ep} return="
+                      f"{float(np.asarray(stats['episodic_return'])):.3f} "
+                      f"succ={float(np.asarray(stats['mean_succ_ratio'])):.3f} "
+                      f"sps={sps:.1f}")
+        self.rewards_writer.close()
+        if self.tb:
+            self.tb.close()
+        return state
+
+    def evaluate(self, state: DDPGState, episodes: int = 1,
+                 test_mode: bool = True) -> Dict[str, float]:
+        """Greedy rollout on the inference network (inference.py:17-40
+        semantics: actor only, no noise, no learning)."""
+        totals = []
+        succ = []
+        for ep in range(episodes):
+            topo, traffic = self.driver.episode(ep, test_mode)
+            rng = jax.random.PRNGKey(self.seed + 10_000 + ep)
+            env_state, obs = self.env.reset(rng, topo, traffic)
+            ep_reward = 0.0
+            infos = None
+            for _ in range(self.agent_cfg.episode_steps):
+                action = self.ddpg.actor.apply(state.actor_params, obs)
+                action = jax.numpy.clip(action, 0.0, 1.0)
+                action = self.env.process_action(action)
+                env_state, obs, reward, done, infos = self.env.step(
+                    env_state, topo, traffic, action)
+                ep_reward += float(np.asarray(reward))
+            totals.append(ep_reward)
+            succ.append(float(np.asarray(infos["succ_ratio"])))
+        return {"mean_return": float(np.mean(totals)),
+                "final_succ_ratio": float(np.mean(succ))}

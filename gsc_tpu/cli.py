@@ -1,0 +1,231 @@
+"""Command-line interface (reference: root main.py + inference.py +
+coordsim/main.py).
+
+Subcommands:
+- ``init-configs``: generate an example config set (agent/simulator/service/
+  scheduler YAML + Abilene GraphML) — the assets the reference checks in
+  under configs/, produced programmatically here.
+- ``train``: load the 5 config namespaces, train DDPG, save an orbax
+  checkpoint, then roll one greedy test episode on the inference network
+  (main.py:16-76 flow).
+- ``infer``: restore a checkpoint and run test episodes (inference.py:17-40).
+- ``simulate``: standalone simulator smoke-run with a uniform dummy
+  schedule, no RL (coordsim/main.py:19-89).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import click
+import jax
+import numpy as np
+import yaml
+
+
+@click.group()
+def cli():
+    """gsc-tpu: TPU-native service coordination framework."""
+
+
+@cli.command("init-configs")
+@click.option("--out", default="configs", show_default=True)
+def init_configs(out: str):
+    """Write an example config set (agent, simulator, service, scheduler,
+    networks)."""
+    from .topology.synthetic import abilene, line, triangle, write_graphml
+
+    os.makedirs(f"{out}/networks", exist_ok=True)
+    write_graphml(abilene(), f"{out}/networks/abilene-in4.graphml")
+    write_graphml(triangle(), f"{out}/networks/triangle.graphml")
+    write_graphml(line(3), f"{out}/networks/line3.graphml")
+
+    with open(f"{out}/service_abc.yaml", "w") as f:
+        yaml.safe_dump({
+            "sfc_list": {"sfc_1": ["a", "b", "c"]},
+            "sf_list": {n: {"processing_delay_mean": 5.0,
+                            "processing_delay_stdev": 0.0}
+                        for n in "abc"},
+        }, f)
+    with open(f"{out}/simulator.yaml", "w") as f:
+        yaml.safe_dump({
+            "inter_arrival_mean": 10.0, "deterministic_arrival": True,
+            "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+            "flow_size_shape": 0.001, "deterministic_size": True,
+            "run_duration": 100, "ttl_choices": [100],
+        }, f)
+    with open(f"{out}/agent.yaml", "w") as f:
+        yaml.safe_dump({
+            "observation_space": ["ingress_traffic", "node_load", "node_cap"],
+            "graph_mode": True, "episode_steps": 200,
+            "objective": "prio-flow", "target_success": "auto",
+            "GNN_features": 22, "GNN_num_layers": 2, "GNN_num_iter": 2,
+            "GNN_aggr": "mean",
+            "actor_hidden_layer_nodes": [256],
+            "critic_hidden_layer_nodes": [64],
+            "mem_limit": 10000, "batch_size": 100,
+            "nb_steps_warmup_critic": 200, "nb_steps_warmup_actor": 200,
+            "rand_mu": 0.0, "rand_sigma": 0.3,
+            "gamma": 0.99, "target_model_update": 1.0e-4,
+            "learning_rate": 1.0e-3,
+        }, f)
+    with open(f"{out}/scheduler.yaml", "w") as f:
+        yaml.safe_dump({
+            "training_network_files": [f"{out}/networks/abilene-in4.graphml"],
+            "inference_network": f"{out}/networks/abilene-in4.graphml",
+            "period": 10,
+        }, f)
+    click.echo(f"wrote example configs under {out}/")
+
+
+def _build(agent_config, simulator_config, service, scheduler, seed,
+           max_nodes, max_edges):
+    from .config.loader import load_agent, load_scheduler, load_service, load_sim
+    from .config.schema import EnvLimits
+    from .env.driver import EpisodeDriver
+    from .env.env import ServiceCoordEnv
+
+    agent = load_agent(agent_config)
+    sim_cfg = load_sim(simulator_config)
+    svc = load_service(service)
+    sched = load_scheduler(scheduler)
+    limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
+                                   max_edges=max_edges)
+    env = ServiceCoordEnv(svc, sim_cfg, agent, limits)
+    driver = EpisodeDriver(sched, sim_cfg, svc, agent.episode_steps,
+                           max_nodes=max_nodes, max_edges=max_edges,
+                           base_seed=seed)
+    return env, driver, agent
+
+
+@cli.command()
+@click.argument("agent_config")
+@click.argument("simulator_config")
+@click.argument("service")
+@click.argument("scheduler")
+@click.option("--episodes", default=40, show_default=True)
+@click.option("--seed", default=0, show_default=True)
+@click.option("--result-dir", default="results", show_default=True)
+@click.option("--experiment-id", default=None)
+@click.option("--max-nodes", default=24, show_default=True)
+@click.option("--max-edges", default=37, show_default=True)
+@click.option("--tensorboard/--no-tensorboard", default=False)
+@click.option("--verbose/--quiet", default=True)
+def train(agent_config, simulator_config, service, scheduler, episodes, seed,
+          result_dir, experiment_id, max_nodes, max_edges, tensorboard,
+          verbose):
+    """Train DDPG, checkpoint, then one greedy test episode
+    (main.py:16-76)."""
+    from .agents.trainer import Trainer
+    from .utils.checkpoint import save_checkpoint
+    from .utils.experiment import ExperimentResult, copy_inputs, setup_result_dir
+
+    rdir = setup_result_dir(result_dir, experiment_id)
+    copy_inputs(rdir, [agent_config, simulator_config, service, scheduler])
+    result = ExperimentResult(rdir)
+    result.env_config = {"agent_config": agent_config,
+                         "simulator_config": simulator_config,
+                         "service": service, "scheduler": scheduler,
+                         "seed": seed}
+    env, driver, agent = _build(agent_config, simulator_config, service,
+                                scheduler, seed, max_nodes, max_edges)
+    trainer = Trainer(env, driver, agent, seed=seed, result_dir=rdir,
+                      tensorboard=tensorboard)
+    result.runtime_start("train")
+    state = trainer.train(episodes, verbose=verbose)
+    result.runtime_stop("train")
+
+    ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state)
+    result.runtime_start("test")
+    test = trainer.evaluate(state, episodes=1, test_mode=True)
+    result.runtime_stop("test")
+    result.metrics = test
+    result.write()
+    click.echo(json.dumps({"result_dir": rdir, "checkpoint": ckpt, **test}))
+
+
+@cli.command()
+@click.argument("agent_config")
+@click.argument("simulator_config")
+@click.argument("service")
+@click.argument("scheduler")
+@click.argument("checkpoint")
+@click.option("--episodes", default=1, show_default=True)
+@click.option("--seed", default=0, show_default=True)
+@click.option("--max-nodes", default=24, show_default=True)
+@click.option("--max-edges", default=37, show_default=True)
+def infer(agent_config, simulator_config, service, scheduler, checkpoint,
+          episodes, seed, max_nodes, max_edges):
+    """Restore a checkpoint and run greedy test episodes
+    (inference.py:17-40)."""
+    from .agents.trainer import Trainer
+    from .utils.checkpoint import load_checkpoint
+
+    env, driver, agent = _build(agent_config, simulator_config, service,
+                                scheduler, seed, max_nodes, max_edges)
+    trainer = Trainer(env, driver, agent, seed=seed)
+    topo, traffic = driver.episode(0, test_mode=True)
+    _, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)
+    example = trainer.ddpg.init(jax.random.PRNGKey(0), obs)
+    state = load_checkpoint(checkpoint, example)["state"]
+    out = trainer.evaluate(state, episodes=episodes, test_mode=True)
+    click.echo(json.dumps(out))
+
+
+@cli.command()
+@click.option("--duration", "-d", default=1000.0, show_default=True,
+              help="simulated ms")
+@click.option("--network", "-n", required=True)
+@click.option("--service", "-sf", required=True)
+@click.option("--config", "-c", required=True)
+@click.option("--seed", default=0, show_default=True)
+@click.option("--max-nodes", default=24, show_default=True)
+@click.option("--max-edges", default=37, show_default=True)
+def simulate(duration, network, service, config, seed, max_nodes, max_edges):
+    """Standalone simulator run with a uniform schedule over all nodes and
+    every SF placed everywhere — the smoke-run mode of coordsim/main.py:19-89
+    (which uses hard-coded dummy placement/schedule tables)."""
+    import jax.numpy as jnp
+
+    from .config.loader import load_service, load_sim
+    from .config.schema import DROP_REASONS, EnvLimits
+    from .sim.engine import SimEngine
+    from .sim.traffic import generate_traffic
+    from .topology.compiler import load_topology
+
+    svc = load_service(service)
+    sim_cfg = load_sim(config)
+    limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
+                                   max_edges=max_edges)
+    topo = load_topology(network, max_nodes=max_nodes, max_edges=max_edges,
+                         force_link_cap=sim_cfg.force_link_cap,
+                         force_node_cap=sim_cfg.force_node_cap, seed=seed)
+    steps = int(np.ceil(duration / sim_cfg.run_duration))
+    if steps < 1:
+        raise click.BadParameter("duration must cover at least one "
+                                 f"run_duration ({sim_cfg.run_duration} ms)")
+    traffic = generate_traffic(sim_cfg, svc, topo, steps, seed)
+    engine = SimEngine(svc, sim_cfg, limits)
+
+    nm = np.asarray(topo.node_mask)
+    n_real = int(nm.sum())
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, nm] = 1.0 / n_real
+    placement = jnp.asarray(np.broadcast_to(nm[:, None],
+                                            (max_nodes, limits.max_sfs)))
+    state = engine.init(jax.random.PRNGKey(seed), topo)
+    for _ in range(steps):
+        state, metrics = engine.apply(state, topo, traffic,
+                                      jnp.asarray(sched), placement)
+    m = metrics
+    click.echo(json.dumps({
+        "total_flows": int(m.generated), "successful_flows": int(m.processed),
+        "dropped_flows": int(m.dropped),
+        "drop_reasons": {k: int(v) for k, v in
+                         zip(DROP_REASONS, np.asarray(m.drop_reasons))},
+        "avg_end2end_delay": float(m.avg_e2e()),
+    }))
+
+
+if __name__ == "__main__":
+    cli()

@@ -238,6 +238,8 @@ class AgentConfig:
     schedule_threshold: float = 0.1
 
     def __post_init__(self):
+        if self.gnn_num_layers < 1 or self.gnn_num_iter < 1:
+            raise ValueError("gnn_num_layers and gnn_num_iter must be >= 1")
         if self.objective not in SUPPORTED_OBJECTIVES:
             raise ValueError(
                 f"Unexpected objective {self.objective}. Must be in {SUPPORTED_OBJECTIVES}."

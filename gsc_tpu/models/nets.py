@@ -1,0 +1,100 @@
+"""Actor and critic networks (reference: src/rlsp/agents/models.py:55-153).
+
+Graph mode: GNN embedding of the padded network graph, concatenated with the
+flattened action mask (and the action for the critic), through an MLP; the
+actor's output is multiplied by the mask so padded (src, dst) entries are
+exactly zero (models.py:146-153).  Flat mode: plain MLPs over the
+concatenated observation vectors.  (The reference's flat-mode layer sizing is
+internally inconsistent — models.py:80 declares mask-sized inputs its forward
+never builds; we size flat inputs correctly instead.)
+
+MLP semantics follow torch_geometric.nn.MLP with norm=None, plain_last=True:
+Linear -> ReLU between layers, no activation after the last (so the actor's
+output is unbounded; the agent clips to the action box after adding noise,
+simple_ddpg.py:195-201).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config.schema import AgentConfig
+from ..env.observations import GraphObs
+from .gnn import GNNEmbedder
+
+
+class MLP(nn.Module):
+    """Linear/ReLU stack, plain last layer (torch_geometric MLP, norm=None)."""
+
+    features: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+def _embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
+    return GNNEmbedder(hidden=agent.gnn_features,
+                       num_layers=agent.gnn_num_layers,
+                       num_iter=agent.gnn_num_iter,
+                       mean_aggr=agent.gnn_aggr == "mean",
+                       impl=impl)
+
+
+class Actor(nn.Module):
+    """Policy network (models.py:97-153)."""
+
+    agent: AgentConfig
+    action_dim: int
+    gnn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, obs):
+        if self.agent.graph_mode:
+            assert isinstance(obs, GraphObs)
+            emb = _embedder(self.agent, self.gnn_impl)(
+                obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
+            h = jnp.concatenate([emb, obs.mask], axis=-1)
+        else:
+            h = obs
+        out = MLP(tuple(self.agent.actor_hidden_layer_nodes)
+                  + (self.action_dim,))(h)
+        if self.agent.graph_mode:
+            out = out * obs.mask
+        return out
+
+
+class QNetwork(nn.Module):
+    """Critic Q(s, a) (models.py:55-95)."""
+
+    agent: AgentConfig
+    gnn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, obs, action):
+        if self.agent.graph_mode:
+            assert isinstance(obs, GraphObs)
+            emb = _embedder(self.agent, self.gnn_impl)(
+                obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
+            h = jnp.concatenate([emb, obs.mask, action], axis=-1)
+        else:
+            h = jnp.concatenate([obs, action], axis=-1)
+        return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,))(h)
+
+
+def scale_action(action: jnp.ndarray, low: float = 0.0,
+                 high: float = 1.0) -> jnp.ndarray:
+    """[low, high] -> [-1, 1] (models.py:127-135)."""
+    return 2.0 * (action - low) / (high - low) - 1.0
+
+
+def unscale_action(scaled: jnp.ndarray, low: float = 0.0,
+                   high: float = 1.0) -> jnp.ndarray:
+    """[-1, 1] -> [low, high] (models.py:137-144)."""
+    return low + 0.5 * (scaled + 1.0) * (high - low)

@@ -1,0 +1,98 @@
+"""GATv2 attention math — pure-function XLA implementations.
+
+These are the kernel-level primitives behind ``gsc_tpu.models.gnn``: dense
+masked attention (the default XLA path) and the edge-list segment-sum
+formulation (numerically identical to torch-geometric's sparse computation,
+used for parity tests).  The fused Pallas TPU kernel lives in
+``gsc_tpu.ops.pallas_gat`` and is parity-tested against ``gatv2_dense``.
+
+GATv2 math per directed edge j->i (torch_geometric GATv2Conv semantics,
+reference usage at src/rlsp/agents/models.py:22-27):
+    e_ij   = a^T LeakyReLU_0.2(W_l x_j + W_r x_i)
+    alpha  = softmax_j(e_ij) over in-neighbors (self-loop included)
+    out_i  = aggr_j(alpha_ij * W_l x_j) + b      (aggr: sum or mean)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+LEAKY_SLOPE = 0.2
+
+
+def dense_adj(edge_index: jnp.ndarray, edge_mask: jnp.ndarray,
+              node_mask: jnp.ndarray) -> jnp.ndarray:
+    """Directed edge list -> dense [N, N] bool adjacency ``adj[i, j]`` = "j is
+    an in-neighbor of i", with self-loops on real nodes (GATv2Conv's
+    add_self_loops default).  Leading batch dims supported via vmap."""
+    def one(ei, em, nm):
+        n = nm.shape[0]
+        adj = jnp.zeros((n, n), bool)
+        src, dst = ei[0], ei[1]
+        adj = adj.at[jnp.where(em, dst, n), jnp.where(em, src, n)].set(
+            True, mode="drop")
+        return adj | (jnp.eye(n, dtype=bool) & nm[:, None])
+
+    for _ in range(edge_index.ndim - 2):
+        one = jax.vmap(one)
+    return one(edge_index, edge_mask, node_mask)
+
+
+def gatv2_dense(x: jnp.ndarray, adj: jnp.ndarray, w_l: jnp.ndarray,
+                b_l: jnp.ndarray, w_r: jnp.ndarray, b_r: jnp.ndarray,
+                att: jnp.ndarray, bias: jnp.ndarray,
+                mean_aggr: bool) -> jnp.ndarray:
+    """Dense masked GATv2 layer.  x: [..., N, F_in], adj: [..., N, N] bool."""
+    xl = x @ w_l + b_l                       # [..., N, F] source projection
+    xr = x @ w_r + b_r                       # [..., N, F] target projection
+    e = xl[..., None, :, :] + xr[..., :, None, :]   # [..., i, j, F]
+    e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
+    logits = jnp.einsum("...ijf,f->...ij", e, att)
+    logits = jnp.where(adj, logits, NEG_INF)
+    mx = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+    ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)
+    denom = ex.sum(axis=-1, keepdims=True)
+    alpha = ex / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("...ij,...jf->...if", alpha, xl)
+    if mean_aggr:
+        deg = adj.sum(axis=-1, keepdims=True)
+        out = out / jnp.maximum(deg, 1)
+    has_nbr = adj.any(axis=-1, keepdims=True)
+    return jnp.where(has_nbr, out + bias, 0.0)
+
+
+def gatv2_segment(x: jnp.ndarray, edge_index: jnp.ndarray,
+                  edge_mask: jnp.ndarray, node_mask: jnp.ndarray,
+                  w_l: jnp.ndarray, b_l: jnp.ndarray, w_r: jnp.ndarray,
+                  b_r: jnp.ndarray, att: jnp.ndarray, bias: jnp.ndarray,
+                  mean_aggr: bool) -> jnp.ndarray:
+    """Edge-list segment-sum GATv2 (torch-geometric's sparse formulation),
+    single graph: x [N, F_in], edge_index [2, E].  Self-loops appended for
+    real nodes."""
+    n = x.shape[0]
+    xl = x @ w_l + b_l
+    xr = x @ w_r + b_r
+    loops = jnp.arange(n)
+    # drop any self-loops already present, then append exactly one per real
+    # node (torch-geometric removes and re-adds; the dense path dedups via
+    # the bool adjacency)
+    src = jnp.concatenate([edge_index[0], loops])
+    dst = jnp.concatenate([edge_index[1], loops])
+    em = jnp.concatenate([edge_mask & (edge_index[0] != edge_index[1]),
+                          node_mask])
+    e = xl[src] + xr[dst]
+    e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
+    logits = jnp.where(em, e @ att, NEG_INF)
+    seg_max = jax.ops.segment_max(logits, dst, num_segments=n)
+    seg_max = jax.lax.stop_gradient(
+        jnp.where(jnp.isfinite(seg_max), seg_max, 0.0))
+    ex = jnp.where(em, jnp.exp(logits - seg_max[dst]), 0.0)
+    denom = jax.ops.segment_sum(ex, dst, num_segments=n)
+    alpha = ex / jnp.maximum(denom[dst], 1e-30)
+    out = jax.ops.segment_sum(alpha[:, None] * xl[src], dst, num_segments=n)
+    if mean_aggr:
+        deg = jax.ops.segment_sum(em.astype(x.dtype), dst, num_segments=n)
+        out = out / jnp.maximum(deg[:, None], 1)
+    has_nbr = jax.ops.segment_max(em.astype(jnp.int32), dst, num_segments=n) > 0
+    return jnp.where(has_nbr[:, None], out + bias, 0.0)

@@ -1,0 +1,100 @@
+"""Fused GATv2 attention — Pallas TPU kernel.
+
+One kernel fuses the whole attention stage of a GATv2 layer — pairwise
+LeakyReLU features, attention logits, masked softmax, weighted aggregation —
+for a tile of graphs at a time, keeping the [TB, N, N, F] pairwise
+intermediate in VMEM instead of materializing it in HBM (the XLA fallback
+``gnn.gatv2_dense`` builds that tensor explicitly).  For replay-buffer-sized
+batches (B=100 graphs of 24 padded nodes, sample_agent.yaml) the intermediate
+is ~100*24*24*22*4B ≈ 5 MB per layer invocation; fusing it away makes the
+layer HBM-bound only on x/out.
+
+Inputs are the already-projected source/target features (the projections are
+plain matmuls that XLA maps to the MXU on its own):
+    xl = x @ W_l + b_l, xr = x @ W_r + b_r      (see gnn.GATv2Conv)
+
+Grid: one program per tile of TB graphs; each program computes attention for
+its whole [TB, N, N] block.  N is the padded MAX_NODES (default 24), so a
+tile easily fits VMEM; TB trades VMEM for grid overhead.
+
+On CPU (tests, virtual meshes) the kernel runs in interpret mode and is
+bit-compared against ``gatv2_dense`` (tests/test_models.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .gat import LEAKY_SLOPE, NEG_INF
+
+
+def _gat_kernel(xl_ref, xr_ref, att_ref, bias_ref, adj_ref, out_ref, *,
+                mean_aggr: bool):
+    xl = xl_ref[...]          # [TB, N, F]
+    xr = xr_ref[...]
+    att = att_ref[...]        # [F]
+    bias = bias_ref[...]      # [F]
+    adj = adj_ref[...]        # [TB, N, N] bool
+
+    e = xl[:, None, :, :] + xr[:, :, None, :]          # [TB, i, j, F]
+    e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
+    logits = jax.lax.dot_general(
+        e, att, (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [TB, i, j]
+    logits = jnp.where(adj, logits, NEG_INF)
+    mx = logits.max(axis=-1, keepdims=True)
+    ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)
+    denom = ex.sum(axis=-1, keepdims=True)
+    alpha = ex / jnp.maximum(denom, 1e-30)             # [TB, i, j]
+    out = jax.lax.dot_general(
+        alpha, xl, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)            # [TB, i, F]
+    if mean_aggr:
+        deg = adj.sum(axis=-1, keepdims=True)
+        out = out / jnp.maximum(deg, 1)
+    has_nbr = adj.any(axis=-1, keepdims=True)
+    out_ref[...] = jnp.where(has_nbr, out + bias, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("mean_aggr", "tile_b", "interpret"))
+def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
+                 bias: jnp.ndarray, adj: jnp.ndarray, mean_aggr: bool = True,
+                 tile_b: int = 8, interpret: bool | None = None) -> jnp.ndarray:
+    """Fused attention stage.  xl/xr: [..., N, F] projected features,
+    adj: [..., N, N] bool.  Leading dims are flattened into the graph batch;
+    a single graph (no leading dim) is supported too."""
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    lead = xl.shape[:-2]
+    n, f = xl.shape[-2:]
+    b = 1
+    for d in lead:
+        b *= d
+    xl3 = xl.reshape(b, n, f)
+    xr3 = xr.reshape(b, n, f)
+    adj3 = adj.reshape(b, n, n)
+    pad = (-b) % tile_b
+    if pad:
+        xl3 = jnp.pad(xl3, ((0, pad), (0, 0), (0, 0)))
+        xr3 = jnp.pad(xr3, ((0, pad), (0, 0), (0, 0)))
+        adj3 = jnp.pad(adj3, ((0, pad), (0, 0), (0, 0)))
+    bp = b + pad
+
+    out = pl.pallas_call(
+        functools.partial(_gat_kernel, mean_aggr=mean_aggr),
+        grid=(bp // tile_b,),
+        in_specs=[
+            pl.BlockSpec((tile_b, n, f), lambda i: (i, 0, 0)),
+            pl.BlockSpec((tile_b, n, f), lambda i: (i, 0, 0)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((f,), lambda i: (0,)),
+            pl.BlockSpec((tile_b, n, n), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, n, f), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, n, f), xl.dtype),
+        interpret=interpret,
+    )(xl3, xr3, att, bias, adj3)
+    return out[:b].reshape(*lead, n, f)

@@ -1,0 +1,125 @@
+"""Data-parallel DDPG over vmapped env replicas.
+
+The scale-out path (BASELINE.json configs 2-5): B env replicas step in
+lockstep under ``vmap`` (each with its own traffic sample and PRNG stream,
+sharded across the ``dp`` mesh axis), feeding B per-replica replay shards;
+the learner samples batches across all replicas and updates one replicated
+parameter set — XLA turns the batch-mean gradient into a cross-chip psum
+from the sharding annotations alone (no hand-written collectives).
+
+Replica semantics mirror the single-env agent exactly (same warmup schedule,
+noise, post-processing, episode-end learn burst); with B=1 this reduces to
+``gsc_tpu.agents.DDPG``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..agents.buffer import ReplayBuffer, buffer_add
+from ..agents.ddpg import DDPG, DDPGState
+from ..config.schema import AgentConfig
+from ..env.actions import action_mask
+from ..env.env import ServiceCoordEnv
+
+
+class ParallelDDPG:
+    """B-replica data-parallel wrapper around the DDPG kernels."""
+
+    def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
+                 num_replicas: int, gnn_impl: str = "dense"):
+        self.env = env
+        self.agent = agent
+        self.B = num_replicas
+        self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl)
+
+    # ----------------------------------------------------------------- init
+    def init(self, rng, sample_obs) -> DDPGState:
+        """Replicated learner state (init from a single-replica obs)."""
+        return self.ddpg.init(rng, sample_obs)
+
+    def init_buffers(self, sample_obs) -> ReplayBuffer:
+        """Per-replica replay shards: leaves [B, capacity, ...]; capacity is
+        mem_limit / B so total memory matches the single-env agent."""
+        cap = max(self.agent.mem_limit // self.B, self.agent.batch_size)
+        example = self.ddpg.example_transition(sample_obs)
+        data = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((self.B, cap) + jnp.shape(x),
+                                jnp.asarray(x).dtype), example)
+        return ReplayBuffer(data=data, pos=jnp.zeros(self.B, jnp.int32),
+                            size=jnp.zeros(self.B, jnp.int32))
+
+    @partial(jax.jit, static_argnums=0)
+    def reset_all(self, rng, topo, traffic):
+        """vmap env.reset across replicas (traffic batched [B, ...])."""
+        keys = jax.random.split(rng, self.B)
+        return jax.vmap(self.env.reset, in_axes=(0, None, 0))(
+            keys, topo, traffic)
+
+    # -------------------------------------------------------------- rollout
+    @partial(jax.jit, static_argnums=0)
+    def rollout_episodes(self, state: DDPGState, buffers: ReplayBuffer,
+                         env_states, obs, topo, traffic,
+                         episode_start_step) -> Tuple[
+                             DDPGState, ReplayBuffer, Any, Any,
+                             Dict[str, jnp.ndarray]]:
+        """One episode on every replica: scan over steps of a vmapped
+        (action -> env.step -> buffer.add) body.  Parameters are shared
+        (replicated); env state, obs, buffers and traffic carry the leading
+        [B] replica axis."""
+        mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
+                           self.env.limits.max_sfs)
+        rng, sub = jax.random.split(state.rng)
+
+        def one_step(es, ob, buf, tr, key, i):
+            action = self.ddpg.choose_action(
+                state.actor_params, ob, mask, episode_start_step + i, key)
+            action = self.env.process_action(action)
+            es, next_ob, reward, done, info = self.env.step(es, topo, tr, action)
+            buf = buffer_add(buf, {
+                "obs": ob, "next_obs": next_ob, "action": action,
+                "reward": reward, "done": done.astype(jnp.float32)})
+            stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
+                     "avg_e2e_delay": info["avg_e2e_delay"]}
+            return es, next_ob, buf, stats
+
+        def step_fn(carry, i):
+            env_states, obs, buffers = carry
+            keys = jax.random.split(jax.random.fold_in(sub, i), self.B)
+            env_states, obs, buffers, stats = jax.vmap(
+                one_step, in_axes=(0, 0, 0, 0, 0, None))(
+                    env_states, obs, buffers, traffic, keys, i)
+            return (env_states, obs, buffers), stats
+
+        (env_states, obs, buffers), stats = jax.lax.scan(
+            step_fn, (env_states, obs, buffers),
+            jnp.arange(self.agent.episode_steps))
+        # stats leaves: [T, B]
+        episode_stats = {
+            "episodic_return": stats["reward"].sum(0).mean(),
+            "mean_succ_ratio": stats["succ_ratio"].mean(),
+            "mean_e2e_delay": stats["avg_e2e_delay"].mean(),
+            "final_succ_ratio": stats["succ_ratio"][-1].mean(),
+        }
+        return (state.replace(rng=rng), buffers, env_states, obs,
+                episode_stats)
+
+    # ------------------------------------------------------------- learning
+    def _sample_across(self, buffers: ReplayBuffer, key):
+        """Uniform batch over (replica, slot) pairs from all shards."""
+        kb, ks = jax.random.split(key)
+        bidx = jax.random.randint(kb, (self.agent.batch_size,), 0, self.B)
+        sidx = jax.random.randint(ks, (self.agent.batch_size,), 0,
+                                  jnp.maximum(buffers.size[bidx], 1))
+        return jax.tree_util.tree_map(lambda d: d[bidx, sidx], buffers.data)
+
+    @partial(jax.jit, static_argnums=0)
+    def learn_burst(self, state: DDPGState, buffers: ReplayBuffer
+                    ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
+        """episode_steps gradient steps sampling across all replica shards
+        (simple_ddpg.py:307-325 schedule)."""
+        return self.ddpg._learn_burst(
+            state, lambda k: self._sample_across(buffers, k))

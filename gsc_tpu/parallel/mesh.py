@@ -1,0 +1,61 @@
+"""Device mesh + sharding helpers.
+
+The reference has no distributed execution of any kind (SURVEY.md §5: one
+process, one env, CPU) — this module is the TPU-native scaling layer the
+rebuild adds (BASELINE.json north_star): a 1-D ``dp`` mesh over which env
+replicas, replay shards and learner batches are sharded, with parameters
+replicated; XLA inserts the cross-chip collectives (grad psum) from the
+sharding annotations.  The same code drives 1 chip, a v5e pod slice, or a
+virtual ``xla_force_host_platform_device_count`` CPU mesh (tests/CI).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.extend  # explicit: clear_backends lives here, not on bare jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all).
+
+    If fewer devices exist than requested, falls back to a virtual CPU
+    platform with ``n_devices`` host devices (the dry-run path for
+    validating multi-chip shardings without hardware)."""
+    devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(f for f in flags.split()
+                         if "xla_force_host_platform_device_count" not in f)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+        jax.config.update("jax_platforms", "cpu")
+        jax.extend.backend.clear_backends()
+        devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_axis0(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def put_replicated(tree, mesh: Mesh):
+    """Replicate a pytree onto every device of the mesh."""
+    return jax.device_put(tree, replicated(mesh))
+
+
+def put_sharded(tree, mesh: Mesh, axis: str = "dp"):
+    """Shard every leaf's leading (replica) axis across the mesh."""
+    return jax.device_put(tree, sharded_axis0(mesh, axis))

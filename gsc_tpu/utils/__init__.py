@@ -1,0 +1,6 @@
+"""Shared utilities: checkpointing, experiment bookkeeping."""
+from .checkpoint import load_checkpoint, save_checkpoint
+from .experiment import ExperimentResult, copy_inputs, setup_result_dir
+
+__all__ = ["load_checkpoint", "save_checkpoint", "ExperimentResult",
+           "copy_inputs", "setup_result_dir"]

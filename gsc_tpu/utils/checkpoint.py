@@ -1,0 +1,49 @@
+"""Checkpoint / resume — orbax-backed, exact-resume semantics.
+
+The reference's persistence is ad hoc: ``th.save(actor)`` + a pickled
+AgentHelper after training (main.py:46-50), reloaded by inference.py:19-23;
+optimizer and replay state are never saved, so continue-training is broken
+(SURVEY.md §5).  Here the *entire* learner state (actor/critic params,
+targets, both optimizer states, PRNG key) and optionally the replay buffer
+are one orbax checkpoint, so training resumes bit-exactly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..agents.buffer import ReplayBuffer
+from ..agents.ddpg import DDPGState
+
+
+def save_checkpoint(path: str, state: DDPGState,
+                    buffer: Optional[ReplayBuffer] = None,
+                    extra: Optional[dict] = None) -> str:
+    """Write learner state (+ optional replay buffer + metadata)."""
+    path = os.path.abspath(path)
+    payload = {"state": state}
+    if buffer is not None:
+        payload["buffer"] = buffer
+    if extra is not None:
+        payload["extra"] = extra
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, payload, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_checkpoint(path: str, example_state: DDPGState,
+                    example_buffer: Optional[ReplayBuffer] = None,
+                    example_extra: Optional[dict] = None) -> dict:
+    """Restore a checkpoint into the shapes/dtypes of the given examples."""
+    path = os.path.abspath(path)
+    target = {"state": example_state}
+    if example_buffer is not None:
+        target["buffer"] = example_buffer
+    if example_extra is not None:
+        target["extra"] = example_extra
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, target)

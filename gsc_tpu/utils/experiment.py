@@ -1,0 +1,65 @@
+"""Experiment bookkeeping: result dirs, config copies, result.yaml.
+
+Mirrors the reference's experiment plumbing — ``setup_files`` copies every
+input config into the run's result directory for reproducibility
+(src/rlsp/agents/main.py:279-306), ``ExperimentResult`` records wall/process
+time per phase into result.yaml (src/rlsp/utils/experiment_result.py:29-54).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from datetime import datetime
+from typing import Dict, List, Optional
+
+import yaml
+
+
+class ExperimentResult:
+    """Phase-timed experiment record (experiment_result.py semantics)."""
+
+    def __init__(self, result_dir: str):
+        self.result_dir = result_dir
+        self.env_config: Dict[str, str] = {}
+        self.agent_config: Dict[str, object] = {}
+        self._timers: Dict[str, Dict[str, float]] = {}
+        self.metrics: Dict[str, float] = {}
+
+    def runtime_start(self, phase: str):
+        self._timers[phase] = {"wall_start": time.time(),
+                               "process_start": time.process_time()}
+
+    def runtime_stop(self, phase: str):
+        t = self._timers[phase]
+        t["wall_time"] = time.time() - t.pop("wall_start")
+        t["process_time"] = time.process_time() - t.pop("process_start")
+
+    def write(self):
+        os.makedirs(self.result_dir, exist_ok=True)
+        record = {
+            "env_config": self.env_config,
+            "agent_config": self.agent_config,
+            "runtimes": self._timers,
+            "metrics": self.metrics,
+        }
+        with open(os.path.join(self.result_dir, "result.yaml"), "w") as f:
+            yaml.safe_dump(record, f, default_flow_style=False)
+
+
+def setup_result_dir(base: str, experiment_id: Optional[str] = None) -> str:
+    """results/<id>/<timestamp>/ (main.py:175-235 layout)."""
+    ts = datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
+    d = os.path.join(base, experiment_id or "default", ts)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def copy_inputs(result_dir: str, paths: List[Optional[str]]):
+    """Copy all input config files into the result dir
+    (src/rlsp/agents/main.py:279-306)."""
+    dst = os.path.join(result_dir, "inputs")
+    os.makedirs(dst, exist_ok=True)
+    for p in paths:
+        if p and os.path.isfile(p):
+            shutil.copy(p, dst)

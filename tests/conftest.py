@@ -2,13 +2,24 @@
 
 Multi-chip TPU hardware is not available in CI; all sharding/collective
 tests run on a virtual 8-device CPU platform, mirroring how the driver
-dry-runs the multi-chip path.  Must run before jax is imported anywhere.
+dry-runs the multi-chip path.
+
+Note: this environment preimports jax at interpreter start (sitecustomize),
+so the JAX_PLATFORMS env var is already latched — ``jax.config.update``
+is the reliable way to select the CPU platform here.  It also keeps tests
+off the single shared TPU (concurrent claims wedge the tunnel).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# exact float32 matmuls so implementation-parity tests compare numerics,
+# not matmul precision modes
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,149 @@
+"""DDPG learner tests: buffer semantics, action selection, gradient steps,
+and a short end-to-end training smoke run (graph mode)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.agents import DDPG, Trainer, buffer_add, buffer_init, buffer_sample
+from gsc_tpu.config.schema import (
+    AgentConfig,
+    EnvLimits,
+    SchedulerConfig,
+    ServiceConfig,
+    ServiceFunction,
+    SimConfig,
+)
+from gsc_tpu.env import EpisodeDriver, ServiceCoordEnv
+from gsc_tpu.sim import generate_traffic
+from gsc_tpu.topology.compiler import NetworkSpec, compile_topology
+
+N, E = 8, 8
+
+
+def make_service():
+    sf = lambda n: ServiceFunction(name=n, processing_delay_mean=5.0,
+                                   processing_delay_stdev=0.0)
+    return ServiceConfig(sfc_list={"sfc_1": ("a", "b", "c")},
+                         sf_list={n: sf(n) for n in "abc"})
+
+
+def line_topo():
+    spec = NetworkSpec(
+        node_caps=[10.0] * 3,
+        node_types=["Ingress", "Normal", "Normal"],
+        edges=[(0, 1, 100.0, 3.0), (1, 2, 100.0, 3.0)],
+    )
+    return compile_topology(spec, max_nodes=N, max_edges=E)
+
+
+def make_stack(episode_steps=4, warmup=4, graph_mode=True):
+    service = make_service()
+    limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
+    agent = AgentConfig(
+        graph_mode=graph_mode, episode_steps=episode_steps,
+        nb_steps_warmup_critic=warmup, nb_steps_warmup_actor=warmup,
+        gnn_features=8, actor_hidden_layer_nodes=(16,),
+        critic_hidden_layer_nodes=(16,), mem_limit=64, batch_size=4,
+        objective="prio-flow")
+    cfg = SimConfig(ttl_choices=(100.0,))
+    env = ServiceCoordEnv(service, cfg, agent, limits)
+    topo = line_topo()
+    traffic = generate_traffic(cfg, service, topo, episode_steps + 2, seed=0)
+    return env, agent, topo, traffic
+
+
+# ---------------------------------------------------------------- buffer
+def test_buffer_ring_semantics():
+    example = {"x": jnp.zeros(3), "y": jnp.zeros((), jnp.int32)}
+    buf = buffer_init(example, capacity=4)
+    for i in range(6):
+        buf = buffer_add(buf, {"x": jnp.full(3, i, jnp.float32),
+                               "y": jnp.asarray(i, jnp.int32)})
+    assert int(buf.size) == 4
+    assert int(buf.pos) == 2
+    # oldest entries (0, 1) overwritten by 4, 5
+    ys = sorted(np.asarray(buf.data["y"]).tolist())
+    assert ys == [2, 3, 4, 5]
+    batch = buffer_sample(buf, jax.random.PRNGKey(0), 32)
+    assert batch["x"].shape == (32, 3)
+    assert set(np.asarray(batch["y"]).tolist()) <= {2, 3, 4, 5}
+
+
+# ---------------------------------------------------------------- actions
+def test_choose_action_warmup_masked():
+    env, agent, topo, traffic = make_stack()
+    ddpg = DDPG(env, agent)
+    _, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+    mask = obs.mask
+    state = ddpg.init(jax.random.PRNGKey(2), obs)
+    a = ddpg.choose_action(state.actor_params, obs, mask, jnp.asarray(0),
+                           jax.random.PRNGKey(1))
+    a = np.asarray(a)
+    assert a.shape == (env.limits.action_dim,)
+    assert (a >= 0).all() and (a <= 1).all()
+    np.testing.assert_array_equal(a[np.asarray(mask) == 0], 0.0)
+
+
+def test_choose_action_policy_clipped():
+    env, agent, topo, traffic = make_stack(warmup=0)
+    ddpg = DDPG(env, agent)
+    _, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+    state = ddpg.init(jax.random.PRNGKey(2), obs)
+    a = ddpg.choose_action(state.actor_params, obs, obs.mask,
+                           jnp.asarray(100), jax.random.PRNGKey(1))
+    a = np.asarray(a)
+    assert (a >= 0).all() and (a <= 1).all()
+
+
+# ---------------------------------------------------------------- learning
+def test_gradient_step_changes_params_and_targets_slowly():
+    env, agent, topo, traffic = make_stack()
+    ddpg = DDPG(env, agent)
+    _, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+    state = ddpg.init(jax.random.PRNGKey(1), obs)
+    buf = ddpg.init_buffer(obs)
+    action = jnp.ones(env.limits.action_dim) * 0.5
+    buf = buffer_add(buf, {"obs": obs, "next_obs": obs, "action": action,
+                           "reward": jnp.asarray(1.0),
+                           "done": jnp.asarray(0.0)})
+    new_state, metrics = ddpg.gradient_step(state, buf, jax.random.PRNGKey(3))
+    # online params moved
+    diff = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        state.critic_params, new_state.critic_params)
+    assert max(jax.tree_util.tree_leaves(diff)) > 0
+    # targets moved by tau=1e-4 fraction only
+    tdiff = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        state.target_critic_params, new_state.target_critic_params)
+    assert 0 < max(jax.tree_util.tree_leaves(tdiff)) < 1e-3
+    assert np.isfinite(float(metrics["critic_loss"]))
+
+
+# ------------------------------------------------------------- end-to-end
+@pytest.mark.parametrize("graph_mode", [True, False])
+def test_trainer_smoke(tmp_path, graph_mode):
+    """3 episodes of 4 steps end-to-end: rollout scan + learn burst, reward
+    history recorded, rewards.csv written."""
+    env, agent, topo, traffic = make_stack(graph_mode=graph_mode)
+    scheduler = SchedulerConfig(training_network_files=("x",),
+                                inference_network="x", period=10)
+    driver = EpisodeDriver.__new__(EpisodeDriver)
+    driver.scheduler = scheduler
+    driver.sim_cfg = env.sim_cfg
+    driver.service = env.service
+    driver.episode_steps = agent.episode_steps
+    driver.base_seed = 0
+    driver.topologies = [topo]
+    driver.inference_topology = topo
+    driver.trace = None
+    driver.capacity = traffic.capacity
+
+    trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path))
+    state = trainer.train(episodes=3)
+    assert len(trainer.history) == 3
+    rows = (tmp_path / "rewards.csv").read_text().strip().splitlines()
+    assert rows[0] == "r" and len(rows) == 4
+    result = trainer.evaluate(state, episodes=1)
+    assert np.isfinite(result["mean_return"])

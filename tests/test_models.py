@@ -1,0 +1,152 @@
+"""Model tests: GATv2 implementation parity (dense vs segment vs Pallas),
+embedder weight tying, actor/critic shapes and masking.
+
+The reference has no model tests at all; SURVEY.md §4 calls for parity tests
+between the Pallas kernel and the XLA reference implementation — these are
+them (Pallas runs in interpret mode on CPU).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.config.schema import AgentConfig
+from gsc_tpu.env.observations import GraphObs
+from gsc_tpu.models import Actor, GNNEmbedder, QNetwork, dense_adj
+from gsc_tpu.models.gnn import GATv2Conv
+from gsc_tpu.ops.pallas_gat import gatv2_pallas
+
+N, E, F_IN = 8, 8, 3
+
+
+def random_graph(key, batch=()):
+    """Random connected-ish graph with 5 real nodes / 6 real edges."""
+    k1, = jax.random.split(key, 1)
+    nodes = jax.random.uniform(k1, batch + (N, F_IN))
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 4], [4, 0], [1, 3]]).T
+    ei = np.zeros((2, 2 * E), np.int32)
+    em = np.zeros(2 * E, bool)
+    ei[:, :6] = edges
+    ei[:, E:E + 6] = edges[::-1]
+    em[:6] = em[E:E + 6] = True
+    nm = np.zeros(N, bool)
+    nm[:5] = True
+    bc = lambda x: jnp.broadcast_to(jnp.asarray(x), batch + x.shape)
+    return nodes, bc(ei), bc(em), bc(nm)
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return random_graph(jax.random.PRNGKey(0))
+
+
+def test_dense_vs_segment_parity(graph):
+    nodes, ei, em, nm = graph
+    conv = GATv2Conv(features=16, mean_aggr=True, impl="dense")
+    params = conv.init(jax.random.PRNGKey(1), nodes,
+                       adj=dense_adj(ei, em, nm))
+    out_dense = conv.apply(params, nodes, adj=dense_adj(ei, em, nm))
+    seg = GATv2Conv(features=16, mean_aggr=True, impl="segment")
+    out_seg = seg.apply(params, nodes, edge_index=ei, edge_mask=em,
+                        node_mask=nm)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_seg),
+                               rtol=1e-5, atol=1e-6)
+    # padded nodes produce exactly zero
+    assert not np.asarray(out_dense)[5:].any()
+
+
+def test_dense_vs_pallas_parity(graph):
+    nodes, ei, em, nm = graph
+    adj = dense_adj(ei, em, nm)
+    conv = GATv2Conv(features=16, mean_aggr=True, impl="dense")
+    params = conv.init(jax.random.PRNGKey(1), nodes, adj=adj)
+    out_dense = conv.apply(params, nodes, adj=adj)
+    p = params["params"]
+    xl = nodes @ p["w_l"] + p["b_l"]
+    xr = nodes @ p["w_r"] + p["b_r"]
+    out_pl = gatv2_pallas(xl, xr, p["att"][:, 0], p["bias"], adj,
+                          mean_aggr=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_pl),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_batched_and_sum_aggr():
+    nodes, ei, em, nm = random_graph(jax.random.PRNGKey(2), batch=(5,))
+    adj = dense_adj(ei, em, nm)
+    conv = GATv2Conv(features=4, mean_aggr=False, impl="dense")
+    params = conv.init(jax.random.PRNGKey(1), nodes, adj=adj)
+    out_dense = conv.apply(params, nodes, adj=adj)
+    p = params["params"]
+    xl = nodes @ p["w_l"] + p["b_l"]
+    xr = nodes @ p["w_r"] + p["b_r"]
+    out_pl = gatv2_pallas(xl, xr, p["att"][:, 0], p["bias"], adj,
+                          mean_aggr=False, tile_b=2, interpret=True)
+    assert out_pl.shape == (5, N, 4)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_pl),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_embedder_weight_tying(graph):
+    """num_layers=2, num_iter=2 must create exactly 2 conv parameter sets
+    (encoder + one shared process conv), models.py:22-27, 44-53."""
+    nodes, ei, em, nm = graph
+    emb = GNNEmbedder(hidden=16, num_layers=2, num_iter=2)
+    params = emb.init(jax.random.PRNGKey(0), nodes, ei, em, nm)
+    names = set(params["params"].keys())
+    assert names == {"encoder", "process_0"}
+    out = emb.apply(params, nodes, ei, em, nm)
+    assert out.shape == (16,)
+
+
+def test_embedder_batched(graph):
+    nodes, ei, em, nm = random_graph(jax.random.PRNGKey(3), batch=(4,))
+    emb = GNNEmbedder(hidden=8, num_layers=2, num_iter=2)
+    params = emb.init(jax.random.PRNGKey(0), nodes, ei, em, nm)
+    out = emb.apply(params, nodes, ei, em, nm)
+    assert out.shape == (4, 8)
+
+
+def make_obs(batch=()):
+    nodes, ei, em, nm = random_graph(jax.random.PRNGKey(0), batch=batch)
+    a = 5 * 1 * 2 * 5  # 5 real nodes, 1 sfc, 2 sfs... use full padded dims
+    mask = jnp.broadcast_to(
+        (jnp.arange(N * 1 * 2 * N) % 2 == 0).astype(jnp.float32),
+        batch + (N * 1 * 2 * N,))
+    return GraphObs(nodes=nodes, node_mask=nm, edge_index=ei, edge_mask=em,
+                    mask=mask)
+
+
+def test_actor_mask_and_shapes():
+    agent = AgentConfig(graph_mode=True, gnn_features=8,
+                        actor_hidden_layer_nodes=(32,))
+    obs = make_obs()
+    action_dim = N * 1 * 2 * N
+    actor = Actor(agent=agent, action_dim=action_dim)
+    params = actor.init(jax.random.PRNGKey(0), obs)
+    out = actor.apply(params, obs)
+    assert out.shape == (action_dim,)
+    # masked entries exactly zero (models.py:151-152)
+    np.testing.assert_array_equal(np.asarray(out)[1::2], 0.0)
+
+
+def test_critic_batched():
+    agent = AgentConfig(graph_mode=True, gnn_features=8,
+                        critic_hidden_layer_nodes=(16,))
+    obs = make_obs(batch=(6,))
+    action_dim = N * 1 * 2 * N
+    action = jnp.ones((6, action_dim)) * 0.5
+    q = QNetwork(agent=agent)
+    params = q.init(jax.random.PRNGKey(0), obs, action)
+    out = q.apply(params, obs, action)
+    assert out.shape == (6, 1)
+
+
+def test_flat_mode_networks():
+    agent = AgentConfig(graph_mode=False)
+    obs = jnp.ones((4, 24))
+    actor = Actor(agent=agent, action_dim=10)
+    params = actor.init(jax.random.PRNGKey(0), obs)
+    assert actor.apply(params, obs).shape == (4, 10)
+    q = QNetwork(agent=agent)
+    qp = q.init(jax.random.PRNGKey(0), obs, jnp.ones((4, 10)))
+    assert q.apply(qp, obs, jnp.ones((4, 10))).shape == (4, 1)
