@@ -127,25 +127,64 @@ class DDPG:
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
                            self.env.limits.max_sfs)
         rng, sub = jax.random.split(state.rng)
+        shuffle = self.agent.shuffle_nodes
+        n = self.env.limits.max_nodes
+
+        def permute(obs, perm):
+            from ..env.permutation import permute_flat_obs, permute_graph_obs
+            if self.agent.graph_mode:
+                return permute_graph_obs(obs, perm, self.env.limits.num_sfcs,
+                                         self.env.limits.max_sfs)
+            return permute_flat_obs(obs, perm)
+
+        if shuffle:
+            # obs in the carry is already permuted; the env needs the action
+            # mapped back through the inverse permutation before stepping
+            # (gym_env.py:193-206 flow)
+            from ..env.permutation import random_permutation
+            sub, k0 = jax.random.split(sub)
+            perm0 = random_permutation(k0, n)
+            obs = permute(obs, perm0)
+        else:
+            perm0 = jnp.arange(n)
 
         def step_fn(carry, i):
-            env_state, obs, buffer = carry
+            env_state, obs, perm, buffer = carry
             k = jax.random.fold_in(sub, i)
-            action = self.choose_action(state.actor_params, obs, mask,
+            if self.agent.graph_mode:
+                step_mask = obs.mask      # permuted along with the obs
+            elif shuffle:
+                m4 = mask.reshape(self.env.limits.scheduling_shape)
+                step_mask = m4[perm][..., perm].reshape(-1)
+            else:
+                step_mask = mask
+            action = self.choose_action(state.actor_params, obs, step_mask,
                                         episode_start_step + i, k)
             action = self.env.process_action(action)
+            env_action = action
+            if shuffle:
+                from ..env.permutation import (
+                    random_permutation,
+                    reverse_action_permutation,
+                )
+                env_action = reverse_action_permutation(
+                    action, perm, self.env.limits.scheduling_shape)
             env_state, next_obs, reward, done, info = self.env.step(
-                env_state, topo, traffic, action)
+                env_state, topo, traffic, env_action)
+            next_perm = perm
+            if shuffle:
+                next_perm = random_permutation(jax.random.fold_in(k, 1), n)
+                next_obs = permute(next_obs, next_perm)
             buffer = buffer_add(buffer, {
                 "obs": obs, "next_obs": next_obs, "action": action,
                 "reward": reward, "done": done.astype(jnp.float32),
             })
             stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
                      "avg_e2e_delay": info["avg_e2e_delay"]}
-            return (env_state, next_obs, buffer), stats
+            return (env_state, next_obs, next_perm, buffer), stats
 
-        (env_state, obs, buffer), stats = jax.lax.scan(
-            step_fn, (env_state, obs, buffer),
+        (env_state, obs, _, buffer), stats = jax.lax.scan(
+            step_fn, (env_state, obs, perm0, buffer),
             jnp.arange(self.agent.episode_steps))
         episode_stats = {
             "episodic_return": stats["reward"].sum(),

@@ -128,9 +128,20 @@ class Trainer:
         return state
 
     def evaluate(self, state: DDPGState, episodes: int = 1,
-                 test_mode: bool = True) -> Dict[str, float]:
+                 test_mode: bool = True, telemetry: bool = False,
+                 write_schedule: bool = False) -> Dict[str, float]:
         """Greedy rollout on the inference network (inference.py:17-40
-        semantics: actor only, no noise, no learning)."""
+        semantics: actor only, no noise, no learning).  With ``telemetry``
+        the reference's test-mode CSV suite is written to
+        <result_dir>/test (writer.py:16-110 schema)."""
+        writer = None
+        if telemetry and self.result_dir:
+            from ..utils.telemetry import TestModeWriter
+            writer = TestModeWriter(
+                os.path.join(self.result_dir, "test"),
+                write_schedule=write_schedule,
+                sf_names=self.env.service.sf_names,
+                sfc_names=self.env.service.sfc_names)
         totals = []
         succ = []
         for ep in range(episodes):
@@ -140,13 +151,42 @@ class Trainer:
             ep_reward = 0.0
             infos = None
             for _ in range(self.agent_cfg.episode_steps):
+                t0 = time.time()
                 action = self.ddpg.actor.apply(state.actor_params, obs)
                 action = jax.numpy.clip(action, 0.0, 1.0)
                 action = self.env.process_action(action)
+                # algorithm runtime per control step (the adapter's
+                # measurement between calls, siminterface/simulator.py:161-167);
+                # block so async dispatch doesn't hide the compute time
+                jax.block_until_ready(action)
+                runtime = time.time() - t0
                 env_state, obs, reward, done, infos = self.env.step(
                     env_state, topo, traffic, action)
                 ep_reward += float(np.asarray(reward))
+                if writer:
+                    from ..env.actions import derive_placement
+                    # the masked schedule the env actually applied (padded
+                    # src/dst zeroed) — not the raw actor output
+                    sched = self.env._masked_schedule(action, topo)
+                    t_steps = traffic.ingress_active.shape[0]
+                    idx = min(int(env_state.sim.run_idx) - 1, t_steps - 1)
+                    active = (topo.is_ingress & topo.node_mask
+                              & traffic.ingress_active[max(idx, 0)])
+                    placement = derive_placement(
+                        sched, self.env.tables.chain_sf,
+                        self.env.tables.chain_len, active,
+                        self.env.limits.max_sfs)
+                    flat = (np.asarray(obs).tolist()
+                            if not self.agent_cfg.graph_mode else
+                            np.asarray(obs.nodes).T.reshape(-1).tolist())
+                    writer.write_step(
+                        episode=ep, time=float(env_state.sim.t),
+                        metrics=env_state.sim.metrics, placement=placement,
+                        node_cap=traffic.node_cap[max(idx, 0)],
+                        schedule=sched, runtime=runtime, rl_state=flat)
             totals.append(ep_reward)
             succ.append(float(np.asarray(infos["succ_ratio"])))
+        if writer:
+            writer.close()
         return {"mean_return": float(np.mean(totals)),
                 "final_succ_ratio": float(np.mean(succ))}

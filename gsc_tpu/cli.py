@@ -137,7 +137,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
 
     ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state)
     result.runtime_start("test")
-    test = trainer.evaluate(state, episodes=1, test_mode=True)
+    test = trainer.evaluate(state, episodes=1, test_mode=True, telemetry=True)
     result.runtime_stop("test")
     result.metrics = test
     result.write()

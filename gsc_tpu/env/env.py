@@ -49,12 +49,16 @@ class ServiceCoordEnv:
     """
 
     def __init__(self, service: ServiceConfig, sim_cfg: SimConfig,
-                 agent: AgentConfig, limits: EnvLimits):
+                 agent: AgentConfig, limits: EnvLimits,
+                 engine: Optional[SimEngine] = None):
         self.service = service
         self.sim_cfg = sim_cfg
         self.agent = agent
         self.limits = limits
-        self.engine = SimEngine(service, sim_cfg, limits)
+        # injectable engine: pass sim.dummy.DummyEngine to exercise the RL
+        # stack without the simulator (the reference's dummy_env pattern)
+        self.engine = engine if engine is not None else SimEngine(
+            service, sim_cfg, limits)
         self.tables = self.engine.tables
         self.min_delay, self.diameter = reward_constants(
             agent, [service.sf_list[n].processing_delay_mean

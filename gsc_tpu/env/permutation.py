@@ -1,0 +1,73 @@
+"""Node-permutation augmentation — pure jnp, vmap-able.
+
+Reference: src/rlsp/envs/simulator_wrapper.py:310-369 (enabled by the
+``shuffle_nodes`` agent flag, off by default, src/rlsp/agents/main.py:254):
+each step the observation's node order is shuffled by a fresh random
+permutation and the agent's action — produced in the shuffled frame — is
+mapped back through the inverse permutation (both source and destination
+axes) before the simulator sees it.
+
+The reference implementation only handles the flat 2-component state via
+Python list slicing; here both observation modes are supported with
+fixed-shape gathers (padded nodes permute like any other — the action mask
+travels with the permutation, so the agent still sees which entries are
+real).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .observations import GraphObs
+
+
+def random_permutation(key, n: int) -> jnp.ndarray:
+    """Fresh node permutation (simulator_wrapper.py:318-319)."""
+    return jax.random.permutation(key, n)
+
+
+def inverse_permutation(perm: jnp.ndarray) -> jnp.ndarray:
+    """inverse[perm[j]] = j (simulator_wrapper.py:327-332)."""
+    return jnp.argsort(perm)
+
+
+def permute_flat_obs(obs: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    """Apply the same node order to every stacked component vector
+    (simulator_wrapper.py:323-325).  obs: [..., F*N] with F stacked
+    node-vectors."""
+    n = perm.shape[0]
+    lead = obs.shape[:-1]
+    v = obs.reshape(lead + (-1, n))
+    return v[..., perm].reshape(obs.shape)
+
+
+def permute_graph_obs(obs: GraphObs, perm: jnp.ndarray,
+                      num_sfcs: int, max_sfs: int) -> GraphObs:
+    """Permute node rows, relabel edges, and permute the action mask
+    consistently with ``permute_action_mask`` below."""
+    inv = inverse_permutation(perm)
+    n = perm.shape[0]
+    mask4 = obs.mask.reshape(obs.mask.shape[:-1] + (n, num_sfcs, max_sfs, n))
+    mask4 = mask4[..., perm, :, :, :][..., perm]
+    return GraphObs(
+        nodes=obs.nodes[..., perm, :],
+        node_mask=obs.node_mask[..., perm],
+        # new node id of old node u is inv[u]
+        edge_index=inv[obs.edge_index],
+        edge_mask=obs.edge_mask,
+        mask=mask4.reshape(obs.mask.shape),
+    )
+
+
+def reverse_action_permutation(action: jnp.ndarray, perm: jnp.ndarray,
+                               scheduling_shape: Tuple[int, int, int, int]
+                               ) -> jnp.ndarray:
+    """Map an action produced in the permuted frame back to the original
+    node order on both source and destination axes
+    (simulator_wrapper.py:334-369)."""
+    inv = inverse_permutation(perm)
+    a = action.reshape(action.shape[:-1] + scheduling_shape)
+    a = a[..., inv, :, :, :][..., inv]
+    return a.reshape(action.shape)

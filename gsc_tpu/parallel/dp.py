@@ -73,29 +73,70 @@ class ParallelDDPG:
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
                            self.env.limits.max_sfs)
         rng, sub = jax.random.split(state.rng)
+        shuffle = self.agent.shuffle_nodes
+        n = self.env.limits.max_nodes
 
-        def one_step(es, ob, buf, tr, key, i):
+        def permute(ob, perm):
+            from ..env.permutation import permute_flat_obs, permute_graph_obs
+            if self.agent.graph_mode:
+                return permute_graph_obs(ob, perm, self.env.limits.num_sfcs,
+                                         self.env.limits.max_sfs)
+            return permute_flat_obs(ob, perm)
+
+        if shuffle:
+            # per-replica node permutations, fresh each step
+            # (simulator_wrapper.py:310-369 via the same helpers as the
+            # single-env agent)
+            sub, k0 = jax.random.split(sub)
+            perms0 = jax.vmap(
+                lambda k: jax.random.permutation(k, n))(
+                    jax.random.split(k0, self.B))
+            obs = jax.vmap(permute)(obs, perms0)
+        else:
+            perms0 = jnp.broadcast_to(jnp.arange(n), (self.B, n))
+
+        def one_step(es, ob, perm, buf, tr, key, i):
+            if self.agent.graph_mode:
+                step_mask = ob.mask
+            elif shuffle:
+                m4 = mask.reshape(self.env.limits.scheduling_shape)
+                step_mask = m4[perm][..., perm].reshape(-1)
+            else:
+                step_mask = mask
             action = self.ddpg.choose_action(
-                state.actor_params, ob, mask, episode_start_step + i, key)
+                state.actor_params, ob, step_mask, episode_start_step + i, key)
             action = self.env.process_action(action)
-            es, next_ob, reward, done, info = self.env.step(es, topo, tr, action)
+            env_action = action
+            if shuffle:
+                from ..env.permutation import (
+                    random_permutation,
+                    reverse_action_permutation,
+                )
+                env_action = reverse_action_permutation(
+                    action, perm, self.env.limits.scheduling_shape)
+            es, next_ob, reward, done, info = self.env.step(es, topo, tr,
+                                                            env_action)
+            next_perm = perm
+            if shuffle:
+                next_perm = random_permutation(jax.random.fold_in(key, 1), n)
+                next_ob = permute(next_ob, next_perm)
             buf = buffer_add(buf, {
                 "obs": ob, "next_obs": next_ob, "action": action,
                 "reward": reward, "done": done.astype(jnp.float32)})
             stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
                      "avg_e2e_delay": info["avg_e2e_delay"]}
-            return es, next_ob, buf, stats
+            return es, next_ob, next_perm, buf, stats
 
         def step_fn(carry, i):
-            env_states, obs, buffers = carry
+            env_states, obs, perms, buffers = carry
             keys = jax.random.split(jax.random.fold_in(sub, i), self.B)
-            env_states, obs, buffers, stats = jax.vmap(
-                one_step, in_axes=(0, 0, 0, 0, 0, None))(
-                    env_states, obs, buffers, traffic, keys, i)
-            return (env_states, obs, buffers), stats
+            env_states, obs, perms, buffers, stats = jax.vmap(
+                one_step, in_axes=(0, 0, 0, 0, 0, 0, None))(
+                    env_states, obs, perms, buffers, traffic, keys, i)
+            return (env_states, obs, perms, buffers), stats
 
-        (env_states, obs, buffers), stats = jax.lax.scan(
-            step_fn, (env_states, obs, buffers),
+        (env_states, obs, _, buffers), stats = jax.lax.scan(
+            step_fn, (env_states, obs, perms0, buffers),
             jnp.arange(self.agent.episode_steps))
         # stats leaves: [T, B]
         episode_stats = {

@@ -12,3 +12,5 @@ from .state import (  # noqa: F401
 )
 from .engine import ServiceTables, SimEngine  # noqa: F401
 from .traffic import TraceEvents, generate_traffic, traffic_capacity  # noqa: F401
+from .perflow import PendingFlows, PerFlowController  # noqa: F401
+from .dummy import DummyEngine  # noqa: F401

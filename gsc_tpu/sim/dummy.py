@@ -1,0 +1,67 @@
+"""Fake simulator backend — the DummySimulator analogue.
+
+Reference: dummy_env/dummy_simulator.py:8-155 implements the simulator
+interface with one canned 3-node state so the RL stack can be exercised
+without running the simulator (SURVEY.md §4's "mock cluster" pattern).
+``DummyEngine`` does the same for the tensor contract: it matches
+``SimEngine``'s ``init``/``apply`` signatures and shapes but fabricates
+deterministic metrics (10 generated, 8 processed, 2 dropped per interval,
+fixed 20 ms average e2e) instead of simulating — jittable, vmappable, and
+drop-in for ``ServiceCoordEnv``'s engine.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import EnvLimits, ServiceConfig, SimConfig
+from ..topology.compiler import Topology
+from .engine import ServiceTables, SimEngine
+from .state import SimMetrics, SimState, TrafficSchedule, init_state
+
+
+class DummyEngine(SimEngine):
+    """Canned-state fake with the SimEngine contract."""
+
+    GENERATED = 10
+    PROCESSED = 8
+    DROPPED = 2
+    AVG_E2E = 20.0
+
+    @partial(jax.jit, static_argnums=0)
+    def apply(self, state: SimState, topo: Topology, traffic: TrafficSchedule,
+              schedule: jnp.ndarray, placement: jnp.ndarray
+              ) -> Tuple[SimState, SimMetrics]:
+        m = state.metrics.reset_run()
+        gen = jnp.asarray(self.GENERATED, jnp.int32)
+        proc = jnp.asarray(self.PROCESSED, jnp.int32)
+        drop = jnp.asarray(self.DROPPED, jnp.int32)
+        # spread canned traffic over the real ingress nodes so observations
+        # are non-trivial (the reference's canned state carries fixed
+        # traffic/load dicts, dummy_simulator.py:51-155)
+        ing = (topo.is_ingress & topo.node_mask).astype(jnp.float32)
+        first_sf = jnp.asarray(self.tables.chain_sf)[:, 0]
+        req = jnp.zeros_like(m.run_requested)
+        for c in range(req.shape[1]):
+            req = req.at[:, c, first_sf[c]].set(ing)
+        proc_traffic = placement.astype(jnp.float32) * 0.5
+        m = m.replace(
+            generated=m.generated + gen, processed=m.processed + proc,
+            dropped=m.dropped + drop,
+            drop_reasons=m.drop_reasons.at[3].add(drop),
+            run_generated=gen, run_processed=proc, run_dropped=drop,
+            run_e2e_sum=jnp.asarray(self.AVG_E2E * self.PROCESSED),
+            run_e2e_max=jnp.asarray(self.AVG_E2E),
+            sum_e2e=m.sum_e2e + self.AVG_E2E * self.PROCESSED,
+            run_requested=req, run_requested_node=ing,
+            run_processed_traffic=proc_traffic,
+        )
+        state = state.replace(
+            t=state.t + self.cfg.run_duration,
+            run_idx=state.run_idx + 1,
+            placed=placement, schedule=schedule, metrics=m,
+        )
+        return state, m

@@ -212,9 +212,56 @@ class SimEngine:
         state = state.replace(run_idx=state.run_idx + 1)
         return state, state.metrics
 
+    # ------------------------------------------------------ per-flow control
+    @partial(jax.jit, static_argnums=0)
+    def apply_substep(self, state: SimState, topo: Topology,
+                      traffic: TrafficSchedule,
+                      ext_decisions: jnp.ndarray) -> SimState:
+        """One substep under *per-flow* control (the reference's
+        FlowController / ExternalDecisionMaker granularity,
+        coordsim/controller/flow_controller.py:21-92).
+
+        ``ext_decisions`` [M] i32: destination node for each flow slot, or -1
+        to leave the flow waiting.  Flows at a decision point without a
+        decision stay parked in the DECIDE phase (the analogue of blocking on
+        ``flow_trigger``, external_decision_maker.py:45-53); the chosen SF is
+        placed on the decided node if absent (place-on-decision,
+        flow_controller.py:46-60).  ``run_idx`` tracks wall sim-time so
+        trace-driven caps/activity stay aligned; run metrics reset at the
+        *start* of each new interval (writer.py:222-225), so after an
+        interval's final substep its run counters remain readable."""
+        # integer substep counter (round() absorbs float32 drift in t)
+        g = jnp.round(state.t / self.dt).astype(jnp.int32)
+        new_idx = g // self.substeps
+        starts_interval = (g % self.substeps == 0) & (g > 0)
+        metrics = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(starts_interval, a, b),
+            state.metrics.reset_run(), state.metrics)
+        state = state.replace(run_idx=jnp.maximum(new_idx, state.run_idx),
+                              metrics=metrics)
+        t_steps = traffic.node_cap.shape[0]
+        idx = jnp.clip(state.run_idx, 0, t_steps - 1)
+        cap_now = traffic.node_cap[idx]
+        return self._substep(state, topo, traffic, cap_now,
+                             ext_decisions=ext_decisions)
+
+    def apply_per_flow(self, state: SimState, topo: Topology,
+                       traffic: TrafficSchedule, decide_fn
+                       ) -> Tuple[SimState, SimMetrics]:
+        """One control interval with a *jitted* per-flow policy:
+        ``decide_fn(state) -> [M] i32`` (-1 = no decision) is invoked every
+        substep — the TPU-native form of the per-flow control loop, keeping
+        the whole interval on device."""
+        def sub(st, _):
+            return self.apply_substep(st, topo, traffic, decide_fn(st)), None
+
+        state, _ = jax.lax.scan(sub, state, None, length=self.substeps)
+        return state, state.metrics
+
     # ---------------------------------------------------------------- substep
     def _substep(self, state: SimState, topo: Topology,
-                 traffic: TrafficSchedule, cap_now: jnp.ndarray) -> SimState:
+                 traffic: TrafficSchedule, cap_now: jnp.ndarray,
+                 ext_decisions: jnp.ndarray | None = None) -> SimState:
         F = state.flows
         m = state.metrics
         dt = self.dt
@@ -325,36 +372,61 @@ class SimEngine:
         sf_now = jnp.asarray(self.tables.chain_sf)[jnp.clip(sfc, 0, self.C - 1),
                                                    sf_pos]
         sf_now = jnp.clip(sf_now, 0)
-        # requested-traffic metric for every WRR decision, before the schedule
-        # lookup (add_requesting_flow, default_decision_maker.py:35-36)
-        m = m.replace(run_requested=m.run_requested.at[
-            jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
-        ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
+        placed = state.placed
+        sf_startup = state.sf_startup
+        if ext_decisions is None:
+            # requested-traffic metric for every WRR decision, before the
+            # schedule lookup (add_requesting_flow,
+            # default_decision_maker.py:35-36)
+            m = m.replace(run_requested=m.run_requested.at[
+                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
+            ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
 
-        # WRR over the schedule row with realized-ratio counters
-        # (default_decision_maker.py:42-66); same-cell same-substep collisions
-        # run in slot-order rounds so later flows see updated counters
-        cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_now
-        rank = _rank_in_cell(cell, wrr, self.N * self.C * self.S)
-        flow_counts = m.run_flow_counts
-        R = self.cfg.wrr_rank_levels
-        for r in range(R):
-            sel = wrr & ((rank == r) if r < R - 1 else (rank >= r))
-            counts = flow_counts[node, jnp.clip(sfc, 0), sf_now]      # [M,N]
-            total = counts.sum(-1, keepdims=True)
-            ratios = jnp.where(total > 0, counts / jnp.maximum(total, 1), 0.0)
-            probs = schedule_row = state.schedule[node, jnp.clip(sfc, 0), sf_now]
-            diffs = jnp.where(probs > 0, probs - ratios, -1.0)
-            choice = jnp.argmax(diffs, axis=-1).astype(jnp.int32)
-            dest = jnp.where(sel, choice, dest)
-            flow_counts = flow_counts.at[
-                jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_now, choice
-            ].add(jnp.where(sel, 1, 0), mode="drop")
-        m = m.replace(run_flow_counts=flow_counts)
+            # WRR over the schedule row with realized-ratio counters
+            # (default_decision_maker.py:42-66); same-cell same-substep
+            # collisions run in slot-order rounds so later flows see updated
+            # counters
+            cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_now
+            rank = _rank_in_cell(cell, wrr, self.N * self.C * self.S)
+            flow_counts = m.run_flow_counts
+            R = self.cfg.wrr_rank_levels
+            for r in range(R):
+                sel = wrr & ((rank == r) if r < R - 1 else (rank >= r))
+                counts = flow_counts[node, jnp.clip(sfc, 0), sf_now]  # [M,N]
+                total = counts.sum(-1, keepdims=True)
+                ratios = jnp.where(total > 0, counts / jnp.maximum(total, 1), 0.0)
+                probs = state.schedule[node, jnp.clip(sfc, 0), sf_now]
+                diffs = jnp.where(probs > 0, probs - ratios, -1.0)
+                choice = jnp.argmax(diffs, axis=-1).astype(jnp.int32)
+                dest = jnp.where(sel, choice, dest)
+                flow_counts = flow_counts.at[
+                    jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_now,
+                    choice
+                ].add(jnp.where(sel, 1, 0), mode="drop")
+            m = m.replace(run_flow_counts=flow_counts)
+        else:
+            # per-flow external control: only flows with a provided decision
+            # proceed; the rest stay parked in DECIDE (flow_trigger blocking,
+            # external_decision_maker.py:45-53)
+            has_dec = ext_decisions >= 0
+            wrr = wrr & has_dec
+            dest = jnp.where(wrr, jnp.clip(ext_decisions, 0, self.N - 1), dest)
+            m = m.replace(run_requested=m.run_requested.at[
+                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
+            ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
+            # place-on-decision (flow_controller.py:46-60): install the SF at
+            # the decided node if absent, stamping its startup time
+            newly_placed = jnp.zeros((self.N, self.S), bool).at[
+                jnp.where(wrr, dest, self.N), sf_now].max(wrr, mode="drop")
+            newly_placed = newly_placed & ~placed
+            placed = placed | newly_placed
+            fresh = newly_placed & ~sf_available
+            sf_startup = jnp.where(fresh, t, sf_startup)
+            sf_available = sf_available | newly_placed
         dest = jnp.where(to_eg, egress, dest)
 
         # --- 5. forwarding --------------------------------------------------
-        fwd = decide
+        fwd = (to_eg | wrr) if ext_decisions is not None else decide
         stay = fwd & (dest == node)
         depart_stay = to_eg & stay                    # at egress already
         need_proc_b = wrr & stay
@@ -400,7 +472,7 @@ class SimEngine:
 
         # --- 6. processing --------------------------------------------------
         need_proc = need_proc_a | need_proc_b
-        sf_ok = state.placed[node, sf_now]
+        sf_ok = placed[node, sf_now]
         # SF not in placement -> drop (default_processor.py:48-50 ->
         # NODE_CAP, flowsimulator.py:114-118)
         drop_unplaced = need_proc & ~sf_ok
@@ -452,7 +524,7 @@ class SimEngine:
         # startup wait (base_processor.py:79-97); a TTL expiry here releases
         # the load immediately (divergence: the reference leaks it)
         sw = jnp.maximum(
-            state.sf_startup[node, sf_now]
+            sf_startup[node, sf_now]
             + jnp.asarray(self.tables.startup_delay)[sf_now] - t, 0.0)
         drop_ttl_sw = admitted_n & (ttl - sw <= _EPS) & (sw > _EPS)
         ttl = jnp.where(drop_ttl_sw, 0.0, ttl)
@@ -522,5 +594,6 @@ class SimEngine:
         return state.replace(
             t=t + dt, flows=flows, cursor=cursor, node_load=node_load,
             sf_available=sf_available, edge_used=edge_used,
+            placed=placed, sf_startup=sf_startup,
             rel_node=rel_node, rel_edge=rel_edge, metrics=m, rng=rng,
         )

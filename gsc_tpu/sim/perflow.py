@@ -1,0 +1,101 @@
+"""Per-flow control — host-driven controller over the batched engine.
+
+The reference's second control granularity (SURVEY.md §3.5): instead of one
+(placement, schedule) action per control interval, an external algorithm
+decides each flow's next node individually.  In the reference the simulator
+blocks on a SimPy ``flow_trigger`` event and hands the waiting flow to the
+algorithm as an ``SPRState`` (coordsim/controller/flow_controller.py:21-92,
+external_decision_maker.py:20-53).
+
+Here the fixed-step engine exposes ``SimEngine.apply_substep(state, ...,
+ext_decisions)``: flows reaching a decision point park in the DECIDE phase
+until a decision arrives (quantized to the next substep — documented
+divergence of the fixed-step design).  Two drivers:
+
+- ``PerFlowController`` (this module): host loop that advances substeps until
+  flows are waiting, surfaces them as a ``PendingFlows`` record (the
+  SPRState analogue), and injects the caller's decisions — for external,
+  non-JAX algorithms.
+- ``SimEngine.apply_per_flow(state, topo, traffic, decide_fn)``: fully
+  on-device variant where ``decide_fn`` is a jitted policy invoked every
+  substep — the TPU-native path (no reference analogue; the reference cannot
+  batch per-flow control at all).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import SimEngine
+from .state import PH_DECIDE, SimState, TrafficSchedule
+from ..topology.compiler import Topology
+
+
+@dataclass
+class PendingFlows:
+    """Flows waiting for an external decision (the SPRState analogue,
+    flow_controller.py:73-92: flow + network view)."""
+
+    slots: np.ndarray      # [K] flow-table slot indices
+    node: np.ndarray       # [K] current node
+    sfc: np.ndarray        # [K]
+    position: np.ndarray   # [K] chain position
+    dr: np.ndarray         # [K]
+    ttl: np.ndarray        # [K]
+    t: float               # current sim time (ms)
+
+    def __len__(self):
+        return len(self.slots)
+
+
+class PerFlowController:
+    """Host-side per-flow control loop (FlowController.get_init_state /
+    get_next_state semantics, flow_controller.py:30-92)."""
+
+    def __init__(self, engine: SimEngine, topo: Topology,
+                 traffic: TrafficSchedule):
+        self.engine = engine
+        self.topo = topo
+        self.traffic = traffic
+        self._none = jnp.full(engine.M, -1, jnp.int32)
+
+    def _pending(self, state: SimState) -> PendingFlows:
+        f = state.flows
+        waiting = np.asarray(f.phase == PH_DECIDE)
+        chain_len = self.engine.tables.chain_len[np.asarray(f.sfc)]
+        # egress routing stays automatic; only SF-position decisions wait
+        waiting = waiting & (np.asarray(f.position) < chain_len)
+        slots = np.nonzero(waiting)[0]
+        return PendingFlows(
+            slots=slots, node=np.asarray(f.node)[slots],
+            sfc=np.asarray(f.sfc)[slots],
+            position=np.asarray(f.position)[slots],
+            dr=np.asarray(f.dr)[slots], ttl=np.asarray(f.ttl)[slots],
+            t=float(state.t))
+
+    def run_until_decision(self, state: SimState, max_substeps: int = 10_000
+                           ) -> tuple[SimState, PendingFlows]:
+        """Advance substeps until at least one flow waits for a decision or
+        the substep budget is exhausted (the env.run-until-flow_trigger loop,
+        flow_controller.py:30-42)."""
+        for _ in range(max_substeps):
+            pending = self._pending(state)
+            if len(pending):
+                return state, pending
+            state = self.engine.apply_substep(state, self.topo, self.traffic,
+                                              self._none)
+        return state, self._pending(state)
+
+    def decide(self, state: SimState, pending: PendingFlows,
+               destinations: np.ndarray) -> SimState:
+        """Apply the algorithm's decisions (destination node per pending
+        flow; -1 leaves a flow waiting) and advance one substep
+        (FlowController.get_next_state, flow_controller.py:44-71)."""
+        dec = np.full(self.engine.M, -1, np.int32)
+        dec[pending.slots] = destinations
+        return self.engine.apply_substep(state, self.topo, self.traffic,
+                                         jnp.asarray(dec))

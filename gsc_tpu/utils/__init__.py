@@ -1,6 +1,7 @@
-"""Shared utilities: checkpointing, experiment bookkeeping."""
+"""Shared utilities: checkpointing, experiment bookkeeping, telemetry."""
 from .checkpoint import load_checkpoint, save_checkpoint
 from .experiment import ExperimentResult, copy_inputs, setup_result_dir
+from .telemetry import TestModeWriter
 
 __all__ = ["load_checkpoint", "save_checkpoint", "ExperimentResult",
-           "copy_inputs", "setup_result_dir"]
+           "copy_inputs", "setup_result_dir", "TestModeWriter"]

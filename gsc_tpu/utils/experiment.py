@@ -63,3 +63,27 @@ def copy_inputs(result_dir: str, paths: List[Optional[str]]):
     for p in paths:
         if p and os.path.isfile(p):
             shutil.copy(p, dst)
+
+
+def select_best_agent(result_dirs: List[str], last_k: int = 10) -> str:
+    """Pick the run with the best mean reward over its last ``last_k``
+    episodes (src/rlsp/agents/main.py:89-113 — which reads a stale
+    'episode_reward.csv'/'reward' schema; this reads the live writer's
+    rewards.csv with field 'r', simple_ddpg.py:167)."""
+    import csv
+
+    best_dir, best = None, -float("inf")
+    for d in result_dirs:
+        path = os.path.join(d, "rewards.csv")
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            rewards = [float(row["r"]) for row in csv.DictReader(f)]
+        if not rewards:
+            continue
+        mean = sum(rewards[-last_k:]) / len(rewards[-last_k:])
+        if mean > best:
+            best, best_dir = mean, d
+    if best_dir is None:
+        raise ValueError("no run with a readable rewards.csv")
+    return best_dir

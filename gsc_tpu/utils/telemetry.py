@@ -1,0 +1,122 @@
+"""Test-mode CSV telemetry — schema-compatible with the reference writer.
+
+Reference: coordsim/writer/writer.py:16-235.  In test mode the reference
+streams per-control-interval CSVs (placements, node_metrics, metrics,
+run_flows, drop_reasons, runtimes, rl_state, optional scheduling) from a
+SimPy process.  Here the same files with the same headers are written by the
+evaluation driver from the metrics pytree after each control step — one
+device→host transfer per interval, no process machinery.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config.schema import DROP_REASONS
+
+
+class TestModeWriter:
+    """CSV suite with the reference's file names and headers
+    (writer.py:26-110)."""
+
+    def __init__(self, test_dir: str, write_schedule: bool = False,
+                 sf_names: Sequence[str] = (), sfc_names: Sequence[str] = ()):
+        os.makedirs(test_dir, exist_ok=True)
+        self.sf_names = list(sf_names)
+        self.sfc_names = list(sfc_names)
+        self.write_schedule = write_schedule
+        self._files = {}
+        self._writers = {}
+
+        def w(name, header):
+            f = open(os.path.join(test_dir, name), "w", newline="")
+            self._files[name] = f
+            wr = csv.writer(f)
+            wr.writerow(header)
+            self._writers[name] = wr
+            return wr
+
+        w("placements.csv", ["episode", "time", "node", "sf"])
+        w("node_metrics.csv", ["episode", "time", "node", "node_capacity",
+                               "used_resources", "ingress_traffic"])
+        w("metrics.csv", ["episode", "time", "total_flows", "successful_flows",
+                          "dropped_flows", "in_network_flows",
+                          "avg_end2end_delay"])
+        w("run_flows.csv", ["episode", "time", "successful_flows",
+                            "dropped_flows", "total_flows"])
+        w("runtimes.csv", ["run", "runtime"])
+        w("drop_reasons.csv", ["episode", "time", *DROP_REASONS])
+        # rl_state.csv has no header row in the reference (writer.py:233-235)
+        f = open(os.path.join(test_dir, "rl_state.csv"), "w", newline="")
+        self._files["rl_state.csv"] = f
+        self._writers["rl_state.csv"] = csv.writer(f)
+        if write_schedule:
+            w("scheduling.csv", ["episode", "time", "origin_node", "sfc",
+                                 "sf", "schedule_node", "schedule_prob"])
+        self._run = 0
+
+    def write_step(self, episode: int, time: float, metrics, placement,
+                   node_cap, node_names: Optional[Sequence[str]] = None,
+                   schedule=None, runtime: Optional[float] = None,
+                   rl_state: Optional[Sequence[float]] = None):
+        """Log one control interval from device pytrees."""
+        placement = np.asarray(placement)
+        node_cap = np.asarray(node_cap)
+        n = placement.shape[0]
+        names = (list(node_names) if node_names
+                 else [f"pop{i}" for i in range(n)])
+        sfs = self.sf_names or [f"sf{i}" for i in range(placement.shape[1])]
+
+        for node in range(n):
+            for s in range(placement.shape[1]):
+                if placement[node, s]:
+                    self._writers["placements.csv"].writerow(
+                        [episode, time, names[node], sfs[s]])
+
+        # used_resources = peak demanded capacity this run
+        # (run_max_node_usage, writer.py:183)
+        used = np.asarray(metrics.run_max_node_usage)
+        ingress = np.asarray(metrics.run_requested_node)
+        for node in range(n):
+            if node_cap[node] > 0 or used[node] > 0:
+                self._writers["node_metrics.csv"].writerow(
+                    [episode, time, names[node], node_cap[node], used[node],
+                     ingress[node]])
+
+        self._writers["metrics.csv"].writerow(
+            [episode, time, int(metrics.generated), int(metrics.processed),
+             int(metrics.dropped), int(metrics.active),
+             float(metrics.avg_e2e())])
+        self._writers["run_flows.csv"].writerow(
+            [episode, time, int(metrics.run_processed),
+             int(metrics.run_dropped), int(metrics.run_generated)])
+        self._writers["drop_reasons.csv"].writerow(
+            [episode, time, *np.asarray(metrics.drop_reasons).tolist()])
+        if runtime is not None:
+            self._run += 1
+            self._writers["runtimes.csv"].writerow([self._run, runtime])
+        if rl_state is not None:
+            self._writers["rl_state.csv"].writerow(
+                [episode, time] + [float(x) for x in rl_state])
+        if schedule is not None and self.write_schedule:
+            sched = np.asarray(schedule)
+            sfcs = self.sfc_names or [f"sfc{i}" for i in range(sched.shape[1])]
+            rows = []
+            for src in range(n):
+                for c in range(sched.shape[1]):
+                    for s in range(sched.shape[2]):
+                        for dst in range(n):
+                            p = sched[src, c, s, dst]
+                            if p > 0:
+                                rows.append([episode, time, names[src],
+                                             sfcs[c], sfs[s], names[dst], p])
+            self._writers["scheduling.csv"].writerows(rows)
+        for f in self._files.values():
+            f.flush()
+
+    def close(self):
+        for f in self._files.values():
+            f.close()

@@ -47,3 +47,25 @@ def test_parallel_matches_manual_replica(monkeypatch):
     # so just check both produced finite, populated buffers
     assert int(buffers.size[0]) == 2 and int(buffers.size[1]) == 2
     assert np.isfinite(float(stats["episodic_return"]))
+
+
+def test_parallel_shuffle_nodes_smoke():
+    """shuffle_nodes works through the parallel rollout path too."""
+    import dataclasses
+
+    import __graft_entry__ as ge
+    env, agent, topo, traffic0 = ge._flagship(max_nodes=8, max_edges=8,
+                                              episode_steps=2, max_flows=32)
+    agent = dataclasses.replace(agent, shuffle_nodes=True)
+    env.agent = agent
+    B = 2
+    traffic = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), traffic0)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+    state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
+    assert int(buffers.size[0]) == 2
+    assert np.isfinite(float(stats["episodic_return"]))

@@ -1,0 +1,96 @@
+"""Permutation-augmentation tests (reference: src/tests/test_permutations.py,
+which is stale against the current reference API — these pin the same
+property: permutation then inverse-permutation composes to identity, and the
+permuted action maps back to the frame the simulator expects)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gsc_tpu.env.observations import GraphObs
+from gsc_tpu.env.permutation import (
+    inverse_permutation,
+    permute_flat_obs,
+    permute_graph_obs,
+    random_permutation,
+    reverse_action_permutation,
+)
+
+N, C, S = 6, 1, 2
+
+
+def test_perm_inverse_composition():
+    perm = random_permutation(jax.random.PRNGKey(0), N)
+    inv = inverse_permutation(perm)
+    np.testing.assert_array_equal(np.asarray(perm)[np.asarray(inv)],
+                                  np.arange(N))
+
+
+def test_flat_obs_roundtrip():
+    obs = jnp.arange(3 * N, dtype=jnp.float32)  # 3 stacked node vectors
+    perm = random_permutation(jax.random.PRNGKey(1), N)
+    p = permute_flat_obs(obs, perm)
+    # component structure preserved: each component permuted identically
+    v = np.asarray(obs).reshape(3, N)
+    pv = np.asarray(p).reshape(3, N)
+    np.testing.assert_array_equal(pv, v[:, np.asarray(perm)])
+    back = permute_flat_obs(p, inverse_permutation(perm))
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(obs))
+
+
+def test_action_roundtrip():
+    """Permuting an action then reversing it restores the original
+    (the reference's test_permutations.py property)."""
+    a = jax.random.uniform(jax.random.PRNGKey(2), (N * C * S * N,))
+    perm = random_permutation(jax.random.PRNGKey(3), N)
+    # an action produced in the permuted frame: a_perm[i,...,j] = a[p[i],...,p[j]]
+    a4 = a.reshape(N, C, S, N)
+    a_perm = a4[perm][..., perm].reshape(-1)
+    back = reverse_action_permutation(a_perm, perm, (N, C, S, N))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(a), rtol=1e-6)
+
+
+def test_graph_obs_permutation_consistency():
+    """Edges relabeled so that the same pairs of (permuted) nodes stay
+    connected; mask permuted on both node axes."""
+    nodes = jnp.arange(N, dtype=jnp.float32)[:, None]
+    ei = jnp.asarray([[0, 1, 2], [1, 2, 3]], jnp.int32)
+    em = jnp.ones(3, bool)
+    nm = jnp.ones(N, bool)
+    mask = jnp.arange(N * C * S * N, dtype=jnp.float32)
+    obs = GraphObs(nodes=nodes, node_mask=nm, edge_index=ei, edge_mask=em,
+                   mask=mask)
+    perm = random_permutation(jax.random.PRNGKey(4), N)
+    p = permute_graph_obs(obs, perm, C, S)
+    # node u's feature ends up at row inv[u]
+    inv = np.asarray(inverse_permutation(perm))
+    for u in range(N):
+        assert float(p.nodes[inv[u], 0]) == float(nodes[u, 0])
+    # each edge still connects the same underlying nodes
+    for e in range(3):
+        u, v = int(ei[0, e]), int(ei[1, e])
+        assert int(p.edge_index[0, e]) == inv[u]
+        assert int(p.edge_index[1, e]) == inv[v]
+    # mask entry (i, c, s, j) moved to (inv[i], c, s, inv[j])
+    m4 = np.asarray(mask).reshape(N, C, S, N)
+    pm4 = np.asarray(p.mask).reshape(N, C, S, N)
+    pr = np.asarray(perm)
+    np.testing.assert_array_equal(pm4, m4[pr][..., pr])
+
+
+def test_shuffled_training_smoke():
+    """End-to-end rollout with shuffle_nodes=True (graph mode)."""
+    from tests.test_agent import make_stack
+    from gsc_tpu.agents import DDPG
+
+    env, agent, topo, traffic = make_stack()
+    import dataclasses
+    agent = dataclasses.replace(agent, shuffle_nodes=True)
+    env.agent = agent  # same limits; reward/obs config unchanged
+    ddpg = DDPG(env, agent)
+    env_state, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+    state = ddpg.init(jax.random.PRNGKey(1), obs)
+    buf = ddpg.init_buffer(obs)
+    state, buf, env_state, obs, stats = ddpg.rollout_episode(
+        state, buf, env_state, obs, topo, traffic, jnp.int32(0))
+    assert int(buf.size) == agent.episode_steps
+    assert np.isfinite(float(stats["episodic_return"]))
