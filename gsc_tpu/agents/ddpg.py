@@ -124,57 +124,28 @@ class DDPG:
         Returns (state w/ fresh rng, buffer, final_env_state, final_obs,
         episode stats)."""
         from ..env.actions import action_mask
+        from ..env.permutation import ShuffleOps
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
                            self.env.limits.max_sfs)
         rng, sub = jax.random.split(state.rng)
-        shuffle = self.agent.shuffle_nodes
-        n = self.env.limits.max_nodes
-
-        def permute(obs, perm):
-            from ..env.permutation import permute_flat_obs, permute_graph_obs
-            if self.agent.graph_mode:
-                return permute_graph_obs(obs, perm, self.env.limits.num_sfcs,
-                                         self.env.limits.max_sfs)
-            return permute_flat_obs(obs, perm)
-
-        if shuffle:
-            # obs in the carry is already permuted; the env needs the action
-            # mapped back through the inverse permutation before stepping
-            # (gym_env.py:193-206 flow)
-            from ..env.permutation import random_permutation
-            sub, k0 = jax.random.split(sub)
-            perm0 = random_permutation(k0, n)
-            obs = permute(obs, perm0)
-        else:
-            perm0 = jnp.arange(n)
+        shuffle = ShuffleOps(self.agent, self.env.limits)
+        sub, k0 = jax.random.split(sub)
+        perm0 = shuffle.init_perm(k0)
+        # obs in the carry lives in the current permuted frame; the env gets
+        # actions mapped back through the inverse (gym_env.py:193-206 flow)
+        obs = shuffle.permute_obs(obs, perm0)
 
         def step_fn(carry, i):
             env_state, obs, perm, buffer = carry
             k = jax.random.fold_in(sub, i)
-            if self.agent.graph_mode:
-                step_mask = obs.mask      # permuted along with the obs
-            elif shuffle:
-                m4 = mask.reshape(self.env.limits.scheduling_shape)
-                step_mask = m4[perm][..., perm].reshape(-1)
-            else:
-                step_mask = mask
+            step_mask = shuffle.step_mask(obs, mask, perm)
             action = self.choose_action(state.actor_params, obs, step_mask,
                                         episode_start_step + i, k)
             action = self.env.process_action(action)
-            env_action = action
-            if shuffle:
-                from ..env.permutation import (
-                    random_permutation,
-                    reverse_action_permutation,
-                )
-                env_action = reverse_action_permutation(
-                    action, perm, self.env.limits.scheduling_shape)
             env_state, next_obs, reward, done, info = self.env.step(
-                env_state, topo, traffic, env_action)
-            next_perm = perm
-            if shuffle:
-                next_perm = random_permutation(jax.random.fold_in(k, 1), n)
-                next_obs = permute(next_obs, next_perm)
+                env_state, topo, traffic, shuffle.env_action(action, perm))
+            next_obs, next_perm = shuffle.advance(
+                jax.random.fold_in(k, 1), next_obs, perm)
             buffer = buffer_add(buffer, {
                 "obs": obs, "next_obs": next_obs, "action": action,
                 "reward": reward, "done": done.astype(jnp.float32),

@@ -89,9 +89,15 @@ class Trainer:
                                    global_step)
 
     def train(self, episodes: int, test_mode: bool = False,
-              verbose: bool = False) -> DDPGState:
+              verbose: bool = False, profile: bool = False) -> DDPGState:
         """Train for ``episodes`` episodes (train-at-episode-end schedule,
-        simple_ddpg.py:280-329).  Returns the final learner state."""
+        simple_ddpg.py:280-329).  Returns the final learner state.  With
+        ``profile`` a jax profiler trace of the run is written to
+        <result_dir>/profile (SURVEY.md §5 tracing analogue)."""
+        if profile and self.result_dir:
+            from ..utils.debug import Profiler
+            with Profiler(os.path.join(self.result_dir, "profile")):
+                return self.train(episodes, test_mode, verbose, profile=False)
         rng = jax.random.PRNGKey(self.seed)
         steps_per_ep = self.agent_cfg.episode_steps
 
@@ -164,18 +170,12 @@ class Trainer:
                     env_state, topo, traffic, action)
                 ep_reward += float(np.asarray(reward))
                 if writer:
-                    from ..env.actions import derive_placement
-                    # the masked schedule the env actually applied (padded
-                    # src/dst zeroed) — not the raw actor output
-                    sched = self.env._masked_schedule(action, topo)
+                    # the schedule/placement the env actually applied,
+                    # surfaced by env.step (no recomputation)
+                    sched = infos["schedule"]
+                    placement = infos["placement"]
                     t_steps = traffic.ingress_active.shape[0]
                     idx = min(int(env_state.sim.run_idx) - 1, t_steps - 1)
-                    active = (topo.is_ingress & topo.node_mask
-                              & traffic.ingress_active[max(idx, 0)])
-                    placement = derive_placement(
-                        sched, self.env.tables.chain_sf,
-                        self.env.tables.chain_len, active,
-                        self.env.limits.max_sfs)
                     flat = (np.asarray(obs).tolist()
                             if not self.agent_cfg.graph_mode else
                             np.asarray(obs.nodes).T.reshape(-1).tolist())

@@ -110,38 +110,58 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--max-nodes", default=24, show_default=True)
 @click.option("--max-edges", default=37, show_default=True)
 @click.option("--tensorboard/--no-tensorboard", default=False)
+@click.option("--profile/--no-profile", default=False,
+              help="write a jax profiler trace of training")
+@click.option("--runs", default=1, show_default=True,
+              help="independent seeded runs; the best by mean reward over "
+                   "the last 10 episodes is reported (select_best_agent)")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
-          verbose):
+          profile, runs, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
-    (main.py:16-76)."""
+    (main.py:16-76).  With --runs N, trains N seeds and selects the best
+    (src/rlsp/agents/main.py:89-113 semantics)."""
     from .agents.trainer import Trainer
     from .utils.checkpoint import save_checkpoint
-    from .utils.experiment import ExperimentResult, copy_inputs, setup_result_dir
+    from .utils.experiment import (
+        ExperimentResult,
+        copy_inputs,
+        select_best_agent,
+        setup_result_dir,
+    )
 
-    rdir = setup_result_dir(result_dir, experiment_id)
-    copy_inputs(rdir, [agent_config, simulator_config, service, scheduler])
-    result = ExperimentResult(rdir)
-    result.env_config = {"agent_config": agent_config,
-                         "simulator_config": simulator_config,
-                         "service": service, "scheduler": scheduler,
-                         "seed": seed}
-    env, driver, agent = _build(agent_config, simulator_config, service,
-                                scheduler, seed, max_nodes, max_edges)
-    trainer = Trainer(env, driver, agent, seed=seed, result_dir=rdir,
-                      tensorboard=tensorboard)
-    result.runtime_start("train")
-    state = trainer.train(episodes, verbose=verbose)
-    result.runtime_stop("train")
+    run_dirs = []
+    outputs = {}
+    for run in range(runs):
+        run_seed = seed + run
+        rdir = setup_result_dir(result_dir, experiment_id)
+        run_dirs.append(rdir)
+        copy_inputs(rdir, [agent_config, simulator_config, service, scheduler])
+        result = ExperimentResult(rdir)
+        result.env_config = {"agent_config": agent_config,
+                             "simulator_config": simulator_config,
+                             "service": service, "scheduler": scheduler,
+                             "seed": run_seed}
+        env, driver, agent = _build(agent_config, simulator_config, service,
+                                    scheduler, run_seed, max_nodes, max_edges)
+        trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
+                          tensorboard=tensorboard)
+        result.runtime_start("train")
+        state = trainer.train(episodes, verbose=verbose, profile=profile)
+        result.runtime_stop("train")
 
-    ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state)
-    result.runtime_start("test")
-    test = trainer.evaluate(state, episodes=1, test_mode=True, telemetry=True)
-    result.runtime_stop("test")
-    result.metrics = test
-    result.write()
-    click.echo(json.dumps({"result_dir": rdir, "checkpoint": ckpt, **test}))
+        ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state)
+        result.runtime_start("test")
+        test = trainer.evaluate(state, episodes=1, test_mode=True,
+                                telemetry=True)
+        result.runtime_stop("test")
+        result.metrics = test
+        result.write()
+        outputs[rdir] = {"result_dir": rdir, "checkpoint": ckpt, **test}
+    best = select_best_agent(run_dirs) if runs > 1 else run_dirs[0]
+    click.echo(json.dumps({**outputs[best], "runs": runs,
+                           "all_result_dirs": run_dirs}))
 
 
 @cli.command()

@@ -127,5 +127,8 @@ class ServiceCoordEnv:
         info["run_generated"] = metrics.run_generated
         info["run_processed"] = metrics.run_processed
         info["run_dropped"] = metrics.run_dropped
+        # surface what was actually applied so telemetry doesn't recompute it
+        info["placement"] = placement
+        info["schedule"] = schedule
         state = EnvState(sim=sim, step=step, ewma_flows=ewma)
         return state, self._obs(sim, topo, traffic), reward, done, info

@@ -71,3 +71,53 @@ def reverse_action_permutation(action: jnp.ndarray, perm: jnp.ndarray,
     a = action.reshape(action.shape[:-1] + scheduling_shape)
     a = a[..., inv, :, :, :][..., inv]
     return a.reshape(action.shape)
+
+
+class ShuffleOps:
+    """The per-step shuffle_nodes protocol, shared by the single-env and
+    data-parallel rollouts (gym_env.py:164-206 flow): observations live in a
+    per-step permuted frame, actions map back through the inverse before the
+    env sees them.  With ``shuffle_nodes`` off every method is the identity,
+    so rollout bodies call these unconditionally."""
+
+    def __init__(self, agent, limits):
+        self.agent = agent
+        self.limits = limits
+        self.on = agent.shuffle_nodes
+        self.n = limits.max_nodes
+
+    def init_perm(self, key) -> jnp.ndarray:
+        if not self.on:
+            return jnp.arange(self.n)
+        return random_permutation(key, self.n)
+
+    def permute_obs(self, obs, perm):
+        if not self.on:
+            return obs
+        if self.agent.graph_mode:
+            return permute_graph_obs(obs, perm, self.limits.num_sfcs,
+                                     self.limits.max_sfs)
+        return permute_flat_obs(obs, perm)
+
+    def step_mask(self, obs, mask, perm):
+        """Action mask in the current (possibly permuted) frame."""
+        if self.agent.graph_mode:
+            return obs.mask          # travels with the permuted obs
+        if not self.on:
+            return mask
+        m4 = mask.reshape(self.limits.scheduling_shape)
+        return m4[perm][..., perm].reshape(-1)
+
+    def env_action(self, action, perm):
+        """Action back in the simulator's frame (gym_env.py:193-196)."""
+        if not self.on:
+            return action
+        return reverse_action_permutation(action, perm,
+                                          self.limits.scheduling_shape)
+
+    def advance(self, key, next_obs, perm):
+        """Fresh permutation + permuted next obs (gym_env.py:202-206)."""
+        if not self.on:
+            return next_obs, perm
+        next_perm = random_permutation(key, self.n)
+        return self.permute_obs(next_obs, next_perm), next_perm

@@ -1,0 +1,108 @@
+"""Native (C++) host-path accelerators, loaded via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; this package holds the *host* hot
+paths in C++ — currently the per-episode traffic pre-generation
+(traffic_gen.cpp), which the pure-numpy fallback implements as a per-flow
+Python loop (gsc_tpu/sim/traffic.py).  The shared object is built on first
+use with g++ (no pip/pybind dependencies); any build or load failure falls
+back to numpy silently.  Set ``GSC_TPU_NO_NATIVE=1`` to force the fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "traffic_gen.cpp")
+_SO = os.path.join(_DIR, "_traffic.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    if os.environ.get("GSC_TPU_NO_NATIVE") == "1":
+        _failed = True
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                if not _build():
+                    _failed = True
+                    return None
+            lib = ctypes.CDLL(_SO)
+            lib.gsc_generate_flows.restype = ctypes.c_int
+            lib.gsc_generate_flows.argtypes = [
+                ctypes.c_uint64,
+                ctypes.c_int, ctypes.c_double,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+                ctypes.c_double, ctypes.c_double,
+                ctypes.c_double, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            _lib = lib
+        except Exception:
+            _failed = True
+    return _lib
+
+
+def generate_flows_native(seed: int, means: np.ndarray, run_duration: float,
+                          dr_mean: float, dr_stdev: float, size_shape: float,
+                          det_arrival: bool, det_size: bool,
+                          ttl_choices: np.ndarray, n_sfcs: int,
+                          egress_nodes: np.ndarray, capacity: int):
+    """-> (times, ingress, drs, durs, ttls, sfcs, egs) ndarrays of length n,
+    or None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    steps, n_nodes = means.shape
+    means = np.ascontiguousarray(means, np.float64)
+    ttl = np.ascontiguousarray(ttl_choices, np.float64)
+    eg = np.ascontiguousarray(egress_nodes, np.int32)
+    times = np.empty(capacity, np.float64)
+    ingress = np.empty(capacity, np.int32)
+    drs = np.empty(capacity, np.float64)
+    durs = np.empty(capacity, np.float64)
+    ttls = np.empty(capacity, np.float64)
+    sfcs = np.empty(capacity, np.int32)
+    egs = np.empty(capacity, np.int32)
+    pd = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    pi = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+    n = lib.gsc_generate_flows(
+        ctypes.c_uint64(seed), steps, run_duration, n_nodes, pd(means),
+        dr_mean, dr_stdev, size_shape, int(det_arrival), int(det_size),
+        pd(ttl), len(ttl), n_sfcs, pi(eg), len(eg), capacity,
+        pd(times), pi(ingress), pd(drs), pd(durs), pd(ttls), pi(sfcs),
+        pi(egs))
+    return (times[:n], ingress[:n], drs[:n], durs[:n], ttls[:n], sfcs[:n],
+            egs[:n])

@@ -70,56 +70,26 @@ class ParallelDDPG:
         (action -> env.step -> buffer.add) body.  Parameters are shared
         (replicated); env state, obs, buffers and traffic carry the leading
         [B] replica axis."""
+        from ..env.permutation import ShuffleOps
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
                            self.env.limits.max_sfs)
         rng, sub = jax.random.split(state.rng)
-        shuffle = self.agent.shuffle_nodes
-        n = self.env.limits.max_nodes
-
-        def permute(ob, perm):
-            from ..env.permutation import permute_flat_obs, permute_graph_obs
-            if self.agent.graph_mode:
-                return permute_graph_obs(ob, perm, self.env.limits.num_sfcs,
-                                         self.env.limits.max_sfs)
-            return permute_flat_obs(ob, perm)
-
-        if shuffle:
-            # per-replica node permutations, fresh each step
-            # (simulator_wrapper.py:310-369 via the same helpers as the
-            # single-env agent)
-            sub, k0 = jax.random.split(sub)
-            perms0 = jax.vmap(
-                lambda k: jax.random.permutation(k, n))(
-                    jax.random.split(k0, self.B))
-            obs = jax.vmap(permute)(obs, perms0)
-        else:
-            perms0 = jnp.broadcast_to(jnp.arange(n), (self.B, n))
+        shuffle = ShuffleOps(self.agent, self.env.limits)
+        # per-replica node permutations, fresh each step, via the same
+        # ShuffleOps protocol as the single-env agent
+        sub, k0 = jax.random.split(sub)
+        perms0 = jax.vmap(shuffle.init_perm)(jax.random.split(k0, self.B))
+        obs = jax.vmap(shuffle.permute_obs)(obs, perms0)
 
         def one_step(es, ob, perm, buf, tr, key, i):
-            if self.agent.graph_mode:
-                step_mask = ob.mask
-            elif shuffle:
-                m4 = mask.reshape(self.env.limits.scheduling_shape)
-                step_mask = m4[perm][..., perm].reshape(-1)
-            else:
-                step_mask = mask
+            step_mask = shuffle.step_mask(ob, mask, perm)
             action = self.ddpg.choose_action(
                 state.actor_params, ob, step_mask, episode_start_step + i, key)
             action = self.env.process_action(action)
-            env_action = action
-            if shuffle:
-                from ..env.permutation import (
-                    random_permutation,
-                    reverse_action_permutation,
-                )
-                env_action = reverse_action_permutation(
-                    action, perm, self.env.limits.scheduling_shape)
-            es, next_ob, reward, done, info = self.env.step(es, topo, tr,
-                                                            env_action)
-            next_perm = perm
-            if shuffle:
-                next_perm = random_permutation(jax.random.fold_in(key, 1), n)
-                next_ob = permute(next_ob, next_perm)
+            es, next_ob, reward, done, info = self.env.step(
+                es, topo, tr, shuffle.env_action(action, perm))
+            next_ob, next_perm = shuffle.advance(
+                jax.random.fold_in(key, 1), next_ob, perm)
             buf = buffer_add(buf, {
                 "obs": ob, "next_obs": next_ob, "action": action,
                 "reward": reward, "done": done.astype(jnp.float32)})

@@ -142,7 +142,40 @@ def generate_traffic(
                 caps[k0:, node] = cap
     active = ~np.isnan(means)
 
-    # --- flow records --------------------------------------------------------
+    cap_f = capacity if capacity is not None else traffic_capacity(
+        cfg, len(ing_idx), episode_steps)
+
+    # --- flow records: native C++ sampler when available ---------------------
+    from ..native import generate_flows_native
+
+    native = generate_flows_native(
+        seed=seed, means=means, run_duration=cfg.run_duration,
+        dr_mean=cfg.flow_dr_mean, dr_stdev=cfg.flow_dr_stdev,
+        size_shape=cfg.flow_size_shape,
+        det_arrival=cfg.deterministic_arrival, det_size=cfg.deterministic_size,
+        ttl_choices=np.asarray(cfg.ttl_choices), n_sfcs=len(sfc_ids),
+        egress_nodes=eg_idx, capacity=cap_f)
+    if native is not None:
+        n_times, n_ing, n_drs, n_durs, n_ttls, n_sfcs_a, n_egs = native
+
+        def pad_native(vals, fill, dtype):
+            out = np.full(cap_f, fill, dtype)
+            out[:len(vals)] = np.asarray(vals, dtype)
+            return out
+
+        return TrafficSchedule(
+            arr_time=jnp.asarray(pad_native(n_times, np.inf, np.float32)),
+            arr_ingress=jnp.asarray(pad_native(n_ing, 0, np.int32)),
+            arr_dr=jnp.asarray(pad_native(n_drs, 0.0, np.float32)),
+            arr_duration=jnp.asarray(pad_native(n_durs, 0.0, np.float32)),
+            arr_ttl=jnp.asarray(pad_native(n_ttls, 0.0, np.float32)),
+            arr_sfc=jnp.asarray(pad_native(n_sfcs_a, 0, np.int32)),
+            arr_egress=jnp.asarray(pad_native(n_egs, -1, np.int32)),
+            ingress_active=jnp.asarray(active),
+            node_cap=jnp.asarray(caps, np.float32),
+        )
+
+    # --- numpy fallback ------------------------------------------------------
     times: List[float] = []
     ingress: List[int] = []
     drs: List[float] = []
@@ -194,8 +227,6 @@ def generate_traffic(
 
     order = np.argsort(np.asarray(times, np.float64), kind="stable")
     f = len(order)
-    cap_f = capacity if capacity is not None else traffic_capacity(
-        cfg, len(ing_idx), episode_steps)
     if f > cap_f:  # should not happen with the default pad factor
         order = order[:cap_f]
         f = cap_f

@@ -1,0 +1,99 @@
+"""Debug utilities: simulator-state invariant checks + profiling hooks.
+
+The reference's only runtime safety net is defensive asserts sprinkled
+through the simulator (metrics.py:119-158, default_forwarder.py:51,125,
+base_processor.py:60,135 — SURVEY.md §4) and SimPy's single-threaded
+scheduling in place of race detection (SURVEY.md §5).  The batched-engine
+analogue is a host-side invariant checker over the ``SimState`` pytree —
+run it between intervals in debug runs or property tests — plus
+``jax_debug_nans`` / profiler toggles for the train driver.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..sim.state import PH_DECIDE, PH_FREE, PH_HOP, PH_PROC, SimState
+from ..topology.compiler import Topology
+
+
+def check_invariants(state: SimState, topo: Topology,
+                     chain_len: np.ndarray, tol: float = 1e-3) -> List[str]:
+    """Return a list of violated invariants (empty = healthy).
+
+    Checks the conservation laws the reference asserts piecemeal:
+    non-negative loads, link usage within capacity
+    (default_forwarder.py:95-111), flow phases/positions in range, and
+    metrics bookkeeping consistency (generated = processed + dropped +
+    active, metrics.py:119-127).
+    """
+    errs = []
+    f = state.flows
+    phase = np.asarray(f.phase)
+    m = state.metrics
+
+    if (np.asarray(state.node_load) < -tol).any():
+        errs.append("negative node_load")
+    if (np.asarray(state.edge_used) < -tol).any():
+        errs.append("negative edge_used")
+    over = np.asarray(state.edge_used) > np.asarray(topo.edge_cap) + tol
+    if (over & np.asarray(topo.edge_mask)).any():
+        errs.append("edge_used exceeds edge capacity")
+
+    if not np.isin(phase, [PH_FREE, PH_DECIDE, PH_HOP, PH_PROC]).all():
+        errs.append("invalid flow phase")
+    active = phase != PH_FREE
+    pos = np.asarray(f.position)[active]
+    cl = chain_len[np.asarray(f.sfc)[active]]
+    if (pos < 0).any() or (pos > cl).any():
+        errs.append("flow position outside chain")
+    nodes = np.asarray(f.node)[active]
+    if len(nodes) and (nodes >= topo.max_nodes).any():
+        errs.append("flow at out-of-range node")
+    if (np.asarray(f.ttl)[active] < -tol).any():
+        errs.append("active flow with negative TTL")
+
+    booked = int(m.processed) + int(m.dropped) + int(m.active)
+    if int(m.generated) != booked:
+        errs.append(
+            f"metrics mismatch: generated={int(m.generated)} != "
+            f"processed+dropped+active={booked}")
+    if int(m.active) != int(active.sum()):
+        errs.append(
+            f"active count mismatch: metrics={int(m.active)} "
+            f"table={int(active.sum())}")
+    if int(m.dropped) != int(np.asarray(m.drop_reasons).sum()):
+        errs.append("drop_reasons do not sum to dropped")
+    return errs
+
+
+def assert_invariants(state: SimState, topo: Topology,
+                      chain_len: np.ndarray) -> None:
+    errs = check_invariants(state, topo, chain_len)
+    if errs:
+        raise AssertionError("simulator invariants violated: " + "; ".join(errs))
+
+
+class Profiler:
+    """jax.profiler trace wrapper for the train driver (the rebuild's
+    answer to the reference's wall/process timers, SURVEY.md §5 tracing)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._active = False
+
+    def __enter__(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        return False

@@ -48,11 +48,20 @@ class ExperimentResult:
 
 
 def setup_result_dir(base: str, experiment_id: Optional[str] = None) -> str:
-    """results/<id>/<timestamp>/ (main.py:175-235 layout)."""
+    """results/<id>/<timestamp>/ (main.py:175-235 layout).  Uniquified with
+    a numeric suffix when the second-granularity timestamp collides (e.g.
+    multi-run sweeps starting within one second)."""
     ts = datetime.now().strftime("%Y-%m-%d_%H-%M-%S")
-    d = os.path.join(base, experiment_id or "default", ts)
-    os.makedirs(d, exist_ok=True)
-    return d
+    root = os.path.join(base, experiment_id or "default")
+    d = os.path.join(root, ts)
+    i = 1
+    while True:
+        try:
+            os.makedirs(d)
+            return d
+        except FileExistsError:
+            d = os.path.join(root, f"{ts}_{i}")
+            i += 1
 
 
 def copy_inputs(result_dir: str, paths: List[Optional[str]]):

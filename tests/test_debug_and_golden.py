@@ -1,0 +1,91 @@
+"""Invariant-checker tests + seeded golden-trajectory regression on the
+Abilene benchmark scenario (SURVEY.md §4: deterministic seeded
+golden-trajectory tests of the simulator core — absent in the reference)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.config.schema import EnvLimits, ServiceConfig, ServiceFunction, SimConfig
+from gsc_tpu.sim import SimEngine, generate_traffic
+from gsc_tpu.topology.compiler import compile_topology
+from gsc_tpu.topology.synthetic import abilene
+from gsc_tpu.utils.debug import assert_invariants, check_invariants
+
+
+def abc_service():
+    sf = lambda n: ServiceFunction(name=n, processing_delay_mean=5.0,
+                                   processing_delay_stdev=0.0)
+    return ServiceConfig(sfc_list={"sfc_1": ("a", "b", "c")},
+                         sf_list={n: sf(n) for n in "abc"})
+
+
+@pytest.fixture(scope="module")
+def abilene_run():
+    """20 intervals on Abilene with a uniform schedule over real nodes and
+    everything placed everywhere — fully deterministic."""
+    service = abc_service()
+    limits = EnvLimits(max_nodes=24, max_edges=37, num_sfcs=1, max_sfs=3)
+    cfg = SimConfig(ttl_choices=(100.0,))
+    engine = SimEngine(service, cfg, limits)
+    topo = compile_topology(abilene(node_cap_range=(4, 5)))  # cap 4 everywhere
+    traffic = generate_traffic(cfg, service, topo, 20, seed=42)
+    nm = np.asarray(topo.node_mask)
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, nm] = 1.0 / nm.sum()
+    placement = jnp.asarray(np.broadcast_to(nm[:, None], (24, 3)).copy())
+    state = engine.init(jax.random.PRNGKey(0), topo)
+    states = []
+    for _ in range(20):
+        state, metrics = engine.apply(state, topo, traffic,
+                                      jnp.asarray(sched), placement)
+        states.append(state)
+    return engine, topo, states
+
+
+def test_invariants_hold_throughout(abilene_run):
+    engine, topo, states = abilene_run
+    for st in states[::4] + [states[-1]]:
+        assert_invariants(st, topo, engine.tables.chain_len)
+
+
+def test_invariant_checker_detects_corruption(abilene_run):
+    engine, topo, states = abilene_run
+    st = states[-1]
+    bad = st.replace(node_load=st.node_load - 5.0)
+    assert "negative node_load" in ";".join(
+        check_invariants(bad, topo, engine.tables.chain_len))
+    bad = st.replace(metrics=st.metrics.replace(
+        generated=st.metrics.generated + 7))
+    assert any("metrics mismatch" in e for e in
+               check_invariants(bad, topo, engine.tables.chain_len))
+
+
+def test_golden_trajectory_abilene(abilene_run):
+    """Frozen end-of-run counters for the seeded Abilene scenario — a
+    regression tripwire for any engine semantics change.  Deterministic:
+    integer-ms delays, dt=1, zero-stdev processing, deterministic arrivals.
+    If a deliberate semantics change breaks this, re-freeze the numbers
+    with the printed actuals."""
+    engine, topo, states = abilene_run
+    m = states[-1].metrics
+    actual = {
+        "generated": int(m.generated),
+        "processed": int(m.processed),
+        "dropped": int(m.dropped),
+        "active": int(m.active),
+        "drop_reasons": np.asarray(m.drop_reasons).tolist(),
+        "avg_e2e": round(float(m.avg_e2e()), 2),
+    }
+    print("golden actuals:", actual)
+    # 4 ingresses x 10 flows/interval x 20 intervals
+    assert actual["generated"] == 800
+    assert actual["generated"] == (actual["processed"] + actual["dropped"]
+                                   + actual["active"])
+    # frozen on first run of this test (seed 42, uniform schedule, cap 4)
+    GOLDEN = {"processed": 658, "dropped": 133, "active": 9,
+              "drop_reasons": [0, 0, 0, 133], "avg_e2e": 34.75}
+    assert actual["processed"] == GOLDEN["processed"]
+    assert actual["dropped"] == GOLDEN["dropped"]
+    assert actual["drop_reasons"] == GOLDEN["drop_reasons"]
+    assert actual["avg_e2e"] == pytest.approx(GOLDEN["avg_e2e"], abs=0.1)

@@ -1,0 +1,73 @@
+"""Native C++ traffic generator tests: build + parity with the numpy path."""
+import importlib
+import os
+
+import numpy as np
+import pytest
+
+import gsc_tpu.native as native
+from gsc_tpu.config.schema import MMPPState, ServiceConfig, ServiceFunction, SimConfig
+from gsc_tpu.sim.traffic import generate_traffic
+from gsc_tpu.topology.compiler import NetworkSpec, compile_topology
+
+N, E = 8, 8
+
+
+def service():
+    sf = lambda n: ServiceFunction(name=n)
+    return ServiceConfig(sfc_list={"sfc_1": ("a", "b")},
+                         sf_list={n: sf(n) for n in "ab"})
+
+
+def topo():
+    spec = NetworkSpec(node_caps=[10.0] * 3,
+                       node_types=["Ingress", "Ingress", "Egress"],
+                       edges=[(0, 1, 100.0, 1.0), (1, 2, 100.0, 1.0)])
+    return compile_topology(spec, max_nodes=N, max_edges=E)
+
+
+def test_native_builds_and_loads():
+    lib = native.get_lib()
+    assert lib is not None, "g++ build of traffic_gen.cpp failed"
+    assert os.path.exists(native._SO)
+
+
+def test_native_deterministic_matches_numpy(monkeypatch):
+    """Fully deterministic config -> native and numpy schedules are
+    identical."""
+    cfg = SimConfig(ttl_choices=(100.0,), inter_arrival_mean=7.0)
+    tn = generate_traffic(cfg, service(), topo(), episode_steps=3, seed=0)
+    monkeypatch.setenv("GSC_TPU_NO_NATIVE", "1")
+    native._failed = False
+    native._lib = None
+    tp = generate_traffic(cfg, service(), topo(), episode_steps=3, seed=0)
+    native._failed = False
+    np.testing.assert_allclose(np.asarray(tn.arr_time),
+                               np.asarray(tp.arr_time), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(tn.arr_ingress),
+                                  np.asarray(tp.arr_ingress))
+    np.testing.assert_allclose(np.asarray(tn.arr_dr), np.asarray(tp.arr_dr))
+    np.testing.assert_allclose(np.asarray(tn.arr_duration),
+                               np.asarray(tp.arr_duration), rtol=1e-5)
+
+
+def test_native_stochastic_structure():
+    """Poisson arrivals + Pareto sizes from the native sampler: sane ranges,
+    sorted times, reproducible per seed."""
+    cfg = SimConfig(ttl_choices=(50.0, 100.0), deterministic_arrival=False,
+                    deterministic_size=False, flow_size_shape=2.0,
+                    flow_dr_mean=1.0, flow_dr_stdev=0.2)
+    t1 = generate_traffic(cfg, service(), topo(), episode_steps=4, seed=9)
+    t2 = generate_traffic(cfg, service(), topo(), episode_steps=4, seed=9)
+    times = np.asarray(t1.arr_time)
+    fin = np.isfinite(times)
+    assert fin.sum() > 10
+    assert (np.diff(times[fin]) >= 0).all()
+    np.testing.assert_array_equal(times, np.asarray(t2.arr_time))
+    assert set(np.asarray(t1.arr_ttl)[fin]) <= {50.0, 100.0}
+    assert (np.asarray(t1.arr_dr)[fin] >= 0).all()
+    # pareto+1 sizes -> durations at least 1000/dr ms scale-ish; just sanity
+    assert (np.asarray(t1.arr_duration)[fin] > 0).all()
+    # egress choices are real egress nodes
+    egs = np.asarray(t1.arr_egress)[fin]
+    assert set(egs) == {2}
