@@ -84,6 +84,8 @@ def load_sim(path: str, **overrides) -> SimConfig:
         )
     if "trace_path" in cfg:
         kw["trace_path"] = cfg["trace_path"]
+    if "prediction" in cfg:
+        kw["prediction"] = bool(cfg["prediction"])
     for key in ("max_flows", "release_horizon", "max_arrivals_per_run",
                 "admission_iters", "wrr_rank_levels"):
         if key in cfg:

@@ -149,6 +149,11 @@ class SimConfig:
     # Trace-driven traffic (reference: coordsim/trace_processor/trace_processor.py)
     trace_path: Optional[str] = None
 
+    # Traffic prediction: observations show *upcoming* ingress traffic
+    # instead of the last interval's (reference 'prediction' flag plumbing,
+    # siminterface/simulator.py:47 + traffic_predictor.py:22-56)
+    prediction: bool = False
+
     # Component registry keys (replaces eval()-resolved class name strings,
     # reference: simulatorparams.py:29-38).
     decision_maker: str = "wrr"          # weighted-round-robin (default_decision_maker.py)

@@ -81,12 +81,22 @@ class ServiceCoordEnv:
     def _obs(self, state: SimState, topo: Topology, traffic: TrafficSchedule):
         t_steps = traffic.node_cap.shape[0]
         cap_now = traffic.node_cap[jnp.clip(state.run_idx, 0, t_steps - 1)]
+        override = None
+        if self.sim_cfg.prediction:
+            # show upcoming ingress traffic instead of observed (the traffic
+            # predictor subsystem, traffic_predictor.py:22-56)
+            from ..sim.predictor import predict_ingress_traffic
+            override = predict_ingress_traffic(
+                traffic, state.run_idx, self.sim_cfg.run_duration,
+                self.limits.max_nodes)
         if self.agent.graph_mode:
             return graph_obs(state.metrics, topo, cap_now, self.tables.chain_sf,
                              self.agent.observation_space,
-                             self.limits.num_sfcs, self.limits.max_sfs)
+                             self.limits.num_sfcs, self.limits.max_sfs,
+                             ingress_override=override)
         return flat_obs(state.metrics, topo, cap_now, self.tables.chain_sf,
-                        self.agent.observation_space)
+                        self.agent.observation_space,
+                        ingress_override=override)
 
     def obs_dim(self) -> int:
         """Flat observation length (len(observation_space) stacked node

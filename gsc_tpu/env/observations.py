@@ -47,16 +47,21 @@ def _maxnorm(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def node_features(metrics, topo: Topology, node_cap_now: jnp.ndarray,
-                  chain_sf: np.ndarray, observation_space: Tuple[str, ...]
-                  ) -> jnp.ndarray:
+                  chain_sf: np.ndarray, observation_space: Tuple[str, ...],
+                  ingress_override: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N, F] feature matrix with F = len(observation_space), columns in the
-    configured order (sample_agent.yaml:6-9)."""
+    configured order (sample_agent.yaml:6-9).  ``ingress_override`` replaces
+    the observed ingress traffic (the traffic predictor overwriting the
+    requested-traffic metric, traffic_predictor.py:28-56)."""
     cols = []
     for comp in observation_space:
         if comp == "ingress_traffic":
-            ing = jnp.zeros_like(node_cap_now)
-            for c in range(chain_sf.shape[0]):
-                ing = ing + metrics.run_requested[:, c, int(chain_sf[c, 0])]
+            if ingress_override is not None:
+                ing = ingress_override
+            else:
+                ing = jnp.zeros_like(node_cap_now)
+                for c in range(chain_sf.shape[0]):
+                    ing = ing + metrics.run_requested[:, c, int(chain_sf[c, 0])]
             cols.append(_maxnorm(ing))
         elif comp == "node_load":
             usage = metrics.run_processed_traffic.sum(axis=-1)
@@ -71,20 +76,21 @@ def node_features(metrics, topo: Topology, node_cap_now: jnp.ndarray,
 
 
 def flat_obs(metrics, topo: Topology, node_cap_now: jnp.ndarray,
-             chain_sf: np.ndarray, observation_space: Tuple[str, ...]
-             ) -> jnp.ndarray:
+             chain_sf: np.ndarray, observation_space: Tuple[str, ...],
+             ingress_override: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N * F] concatenation of the selected vectors
     (simulator_wrapper.py:223-230)."""
     feats = node_features(metrics, topo, node_cap_now, chain_sf,
-                          observation_space)
+                          observation_space, ingress_override)
     return feats.T.reshape(-1)
 
 
 def graph_obs(metrics, topo: Topology, node_cap_now: jnp.ndarray,
               chain_sf: np.ndarray, observation_space: Tuple[str, ...],
-              num_sfcs: int, max_sfs: int) -> GraphObs:
+              num_sfcs: int, max_sfs: int,
+              ingress_override: jnp.ndarray | None = None) -> GraphObs:
     feats = node_features(metrics, topo, node_cap_now, chain_sf,
-                          observation_space)
+                          observation_space, ingress_override)
     edge_index, edge_mask = topo.directed_edge_index()
     return GraphObs(
         nodes=jnp.where(topo.node_mask[:, None], feats, 0.0),

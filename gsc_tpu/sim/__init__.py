@@ -14,3 +14,8 @@ from .engine import ServiceTables, SimEngine  # noqa: F401
 from .traffic import TraceEvents, generate_traffic, traffic_capacity  # noqa: F401
 from .perflow import PendingFlows, PerFlowController  # noqa: F401
 from .dummy import DummyEngine  # noqa: F401
+from .predictor import (  # noqa: F401
+    RNNTrafficPredictor,
+    interval_traffic_series,
+    predict_ingress_traffic,
+)

@@ -57,11 +57,15 @@ class PerFlowController:
     get_next_state semantics, flow_controller.py:30-92)."""
 
     def __init__(self, engine: SimEngine, topo: Topology,
-                 traffic: TrafficSchedule):
+                 traffic: TrafficSchedule, writer=None, episode: int = 0):
         self.engine = engine
         self.topo = topo
         self.traffic = traffic
         self._none = jnp.full(engine.M, -1, jnp.int32)
+        # optional TestModeWriter with write_flow_actions for per-decision
+        # telemetry rows (writer.py:112-140)
+        self.writer = writer
+        self.episode = episode
 
     def _pending(self, state: SimState) -> PendingFlows:
         f = state.flows
@@ -97,5 +101,34 @@ class PerFlowController:
         (FlowController.get_next_state, flow_controller.py:44-71)."""
         dec = np.full(self.engine.M, -1, np.int32)
         dec[pending.slots] = destinations
+        if self.writer is not None:
+            self._log_decisions(state, pending, destinations)
         return self.engine.apply_substep(state, self.topo, self.traffic,
                                          jnp.asarray(dec))
+
+    def _log_decisions(self, state: SimState, pending: PendingFlows,
+                       destinations: np.ndarray) -> None:
+        node_cap = np.asarray(
+            self.traffic.node_cap[min(int(state.run_idx),
+                                      self.traffic.node_cap.shape[0] - 1)])
+        node_rem = node_cap - np.asarray(state.node_load).sum(axis=-1)
+        edge_cap = np.asarray(self.topo.edge_cap)
+        edge_rem = edge_cap - np.asarray(state.edge_used)
+        adj = np.asarray(self.topo.adj_edge_id)
+        for i, slot in enumerate(pending.slots):
+            dest = int(destinations[i])
+            cur = int(pending.node[i])
+            if dest < 0:
+                dst_repr, next_rem, lcap, lrem = "None", -1, -1, -1
+            elif dest == cur:
+                dst_repr, next_rem = dest, node_rem[dest]
+                lcap = lrem = "inf"  # same-node: no link (writer.py:124-127)
+            else:
+                eid = int(adj[cur, dest])
+                dst_repr, next_rem = dest, node_rem[dest]
+                lcap = edge_cap[eid] if eid >= 0 else -1
+                lrem = edge_rem[eid] if eid >= 0 else -1
+            self.writer.write_flow_action(
+                self.episode, float(state.t), int(slot),
+                float(pending.ttl[i]), float(pending.ttl[i]), cur, dst_repr,
+                node_rem[cur], next_rem, lcap, lrem)

@@ -1,0 +1,128 @@
+"""Traffic prediction — analytic look-ahead + learned RNN forecaster.
+
+Reference: the dormant traffic-forecasting subsystem
+(coordsim/traffic_predictor/traffic_predictor.py:22-56 — analytic look-ahead
+over the pregenerated flow lists, overwriting the requested-traffic metric
+the observation builder reads — and lstm_predictor.py:16-307, a Keras
+stateful-LSTM one-step forecaster; dead code upstream since keras is not in
+its requirements, SURVEY.md §2).  Both capabilities, alive:
+
+- ``predict_ingress_traffic``: per-node data-rate sum of the arrivals in the
+  *next* control interval, straight from the TrafficSchedule tensors — pure
+  jnp, usable inside the jitted observation path (enable with
+  ``SimConfig.prediction``; the env then shows upcoming instead of observed
+  ingress traffic, mirroring traffic_predictor.py:28-56).
+- ``RNNTrafficPredictor``: a flax GRU one-step forecaster over the
+  per-interval traffic series with min-max scaling, the LSTM_Predictor
+  analogue (train on a trace, predict the next interval's total dr).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .state import TrafficSchedule
+
+
+def predict_ingress_traffic(traffic: TrafficSchedule, run_idx: jnp.ndarray,
+                            run_duration: float, n_nodes: int) -> jnp.ndarray:
+    """[N] predicted ingress dr for control interval ``run_idx`` (the
+    analytic branch of traffic_predictor.py:43-49: every flow arriving
+    before the interval's end contributes its dr)."""
+    t0 = run_idx.astype(jnp.float32) * run_duration
+    t1 = t0 + run_duration
+    in_window = (traffic.arr_time >= t0) & (traffic.arr_time < t1) \
+        & jnp.isfinite(traffic.arr_time)
+    return jnp.zeros(n_nodes).at[
+        jnp.where(in_window, traffic.arr_ingress, n_nodes)
+    ].add(jnp.where(in_window, traffic.arr_dr, 0.0), mode="drop")
+
+
+def interval_traffic_series(traffic: TrafficSchedule, run_duration: float,
+                            episode_steps: int, n_nodes: int) -> np.ndarray:
+    """[T, N] per-interval ingress dr — training data for the learned
+    predictor (lstm_predictor.py gen_training_data analogue)."""
+    times = np.asarray(traffic.arr_time)
+    ing = np.asarray(traffic.arr_ingress)
+    drs = np.asarray(traffic.arr_dr)
+    fin = np.isfinite(times)
+    out = np.zeros((episode_steps, n_nodes), np.float32)
+    k = np.minimum((times[fin] / run_duration).astype(int), episode_steps - 1)
+    np.add.at(out, (k, ing[fin]), drs[fin])
+    return out
+
+
+class _GRUForecaster(nn.Module):
+    hidden: int = 16
+
+    @nn.compact
+    def __call__(self, series):
+        """series: [T, 1] -> [T, 1] one-step-ahead predictions."""
+        scan_cell = nn.scan(nn.GRUCell, variable_broadcast="params",
+                            split_rngs={"params": False},
+                            in_axes=0, out_axes=0)(features=self.hidden)
+        carry = jnp.zeros((1, self.hidden), series.dtype)
+        _, hs = scan_cell(carry, series[:, None, :])     # [T, 1, H]
+        return nn.Dense(1)(hs[:, 0])
+
+
+class RNNTrafficPredictor:
+    """One-step traffic forecaster (LSTM_Predictor analogue,
+    lstm_predictor.py:16-307): min-max scale the per-interval traffic
+    series, train a GRU to predict the next value, query step by step."""
+
+    def __init__(self, hidden: int = 16, lr: float = 1e-2, seed: int = 0):
+        self.model = _GRUForecaster(hidden=hidden)
+        self.seed = seed
+        self.lr = lr
+        self.params = None
+        self.lo = 0.0
+        self.hi = 1.0
+
+    def _scale(self, x):
+        return (x - self.lo) / max(self.hi - self.lo, 1e-9)
+
+    def _unscale(self, y):
+        return y * max(self.hi - self.lo, 1e-9) + self.lo
+
+    def fit(self, series: np.ndarray, epochs: int = 300) -> float:
+        """Train on a 1-D per-interval traffic series; returns final MSE
+        (scaled space)."""
+        import optax
+
+        series = np.asarray(series, np.float32)
+        self.lo, self.hi = float(series.min()), float(series.max())
+        s = self._scale(series)[:, None]
+        x, y = jnp.asarray(s[:-1]), jnp.asarray(s[1:])
+        params = self.model.init(jax.random.PRNGKey(self.seed), x)
+        opt = optax.adam(self.lr)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                pred = self.model.apply(p, x)
+                return jnp.mean((pred - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            upd, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, upd), opt_state, loss
+
+        loss = jnp.inf
+        for _ in range(epochs):
+            params, opt_state, loss = step(params, opt_state)
+        self.params = params
+        return float(loss)
+
+    def predict(self, history: np.ndarray) -> float:
+        """Next-interval traffic given the observed history
+        (lstm_predictor.predict_traffic analogue)."""
+        if self.params is None:
+            raise RuntimeError("fit() first")
+        s = self._scale(np.asarray(history, np.float32))[:, None]
+        pred = self.model.apply(self.params, jnp.asarray(s))
+        return float(self._unscale(np.asarray(pred)[-1, 0]))

@@ -23,11 +23,13 @@ class TestModeWriter:
     (writer.py:26-110)."""
 
     def __init__(self, test_dir: str, write_schedule: bool = False,
+                 write_flow_actions: bool = False,
                  sf_names: Sequence[str] = (), sfc_names: Sequence[str] = ()):
         os.makedirs(test_dir, exist_ok=True)
         self.sf_names = list(sf_names)
         self.sfc_names = list(sfc_names)
         self.write_schedule = write_schedule
+        self.write_flow_actions = write_flow_actions
         self._files = {}
         self._writers = {}
 
@@ -56,7 +58,25 @@ class TestModeWriter:
         if write_schedule:
             w("scheduling.csv", ["episode", "time", "origin_node", "sfc",
                                  "sf", "schedule_node", "schedule_prob"])
+        if write_flow_actions:
+            # per-flow decision rows (writer.py:101-110 header)
+            w("flow_actions.csv", ["episode", "time", "flow_id",
+                                   "flow_rem_ttl", "flow_ttl", "curr_node_id",
+                                   "dest_node", "cur_node_rem_cap",
+                                   "next_node_rem_cap", "link_cap",
+                                   "link_rem_cap"])
         self._run = 0
+
+    def write_flow_action(self, episode: int, time: float, flow_id: int,
+                          rem_ttl: float, ttl: float, cur_node, dest_node,
+                          cur_node_rem_cap: float, next_node_rem_cap: float,
+                          link_cap, link_rem_cap):
+        """One per-flow decision row (writer.py:112-140)."""
+        if self.write_flow_actions:
+            self._writers["flow_actions.csv"].writerow(
+                [episode, time, flow_id, rem_ttl, ttl, cur_node, dest_node,
+                 cur_node_rem_cap, next_node_rem_cap, link_cap, link_rem_cap])
+            self._files["flow_actions.csv"].flush()
 
     def write_step(self, episode: int, time: float, metrics, placement,
                    node_cap, node_names: Optional[Sequence[str]] = None,

@@ -76,6 +76,26 @@ def test_place_on_decision_processes_flow(stack):
     assert int(state.metrics.drop_reasons.sum()) == 0
 
 
+def test_flow_actions_telemetry(stack, tmp_path):
+    """Per-flow decisions logged to flow_actions.csv (writer.py:101-140)."""
+    import csv
+
+    from gsc_tpu.utils.telemetry import TestModeWriter
+
+    engine, topo, traffic = stack
+    writer = TestModeWriter(str(tmp_path), write_flow_actions=True)
+    ctrl = PerFlowController(engine, topo, traffic, writer=writer)
+    state = engine.init(jax.random.PRNGKey(0), topo)
+    state, pending = ctrl.run_until_decision(state)
+    state = ctrl.decide(state, pending, np.full(len(pending), 1))
+    writer.close()
+    with open(tmp_path / "flow_actions.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows[0][:4] == ["episode", "time", "flow_id", "flow_rem_ttl"]
+    assert len(rows) == 1 + len(pending)
+    assert rows[1][6] == "1"          # decided destination
+
+
 def test_jitted_per_flow_policy(stack):
     """On-device per-flow control: a jitted decide_fn drives a whole
     interval (apply_per_flow)."""

@@ -1,0 +1,81 @@
+"""Traffic-predictor tests (reference: the dormant
+coordsim/traffic_predictor subsystem — analytic look-ahead
+traffic_predictor.py:22-56 and the LSTM forecaster lstm_predictor.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.config.schema import AgentConfig, EnvLimits, ServiceConfig, ServiceFunction, SimConfig
+from gsc_tpu.env import ServiceCoordEnv
+from gsc_tpu.sim import (
+    RNNTrafficPredictor,
+    generate_traffic,
+    interval_traffic_series,
+    predict_ingress_traffic,
+)
+from gsc_tpu.topology.compiler import NetworkSpec, compile_topology
+
+N, E = 8, 8
+
+
+def service():
+    sf = lambda n: ServiceFunction(name=n, processing_delay_mean=5.0,
+                                   processing_delay_stdev=0.0)
+    return ServiceConfig(sfc_list={"sfc_1": ("a", "b", "c")},
+                         sf_list={n: sf(n) for n in "abc"})
+
+
+def topo():
+    spec = NetworkSpec(node_caps=[10.0] * 3,
+                       node_types=["Ingress", "Normal", "Normal"],
+                       edges=[(0, 1, 100.0, 3.0), (1, 2, 100.0, 3.0)])
+    return compile_topology(spec, max_nodes=N, max_edges=E)
+
+
+def test_analytic_prediction_matches_upcoming_arrivals():
+    cfg = SimConfig(ttl_choices=(100.0,))
+    tr = generate_traffic(cfg, service(), topo(), episode_steps=3, seed=0)
+    # interval 0: arrivals at 0..90 from ingress 0, dr 1 each -> 10.0
+    pred = predict_ingress_traffic(tr, jnp.asarray(0), 100.0, N)
+    assert float(pred[0]) == pytest.approx(10.0)
+    assert float(pred[1:].sum()) == 0.0
+    # beyond the horizon: nothing
+    pred = predict_ingress_traffic(tr, jnp.asarray(10), 100.0, N)
+    assert float(pred.sum()) == 0.0
+
+
+def test_prediction_flag_changes_first_obs():
+    """With prediction on, the very first observation already shows the
+    upcoming interval's ingress traffic (observed mode shows zeros)."""
+    svc, lim = service(), EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1,
+                                    max_sfs=3)
+    agent = AgentConfig(graph_mode=True, episode_steps=2)
+    tp = topo()
+    cfg_obs = SimConfig(ttl_choices=(100.0,))
+    cfg_pred = SimConfig(ttl_choices=(100.0,), prediction=True)
+    tr = generate_traffic(cfg_obs, svc, tp, 3, seed=0)
+    env_o = ServiceCoordEnv(svc, cfg_obs, agent, lim)
+    env_p = ServiceCoordEnv(svc, cfg_pred, agent, lim)
+    _, obs_o = env_o.reset(jax.random.PRNGKey(0), tp, tr)
+    _, obs_p = env_p.reset(jax.random.PRNGKey(0), tp, tr)
+    assert float(obs_o.nodes[0, 0]) == 0.0      # nothing observed yet
+    assert float(obs_p.nodes[0, 0]) > 0.5       # upcoming traffic visible
+
+
+def test_interval_series_and_rnn_forecaster():
+    cfg = SimConfig(ttl_choices=(100.0,))
+    tr = generate_traffic(cfg, service(), topo(), episode_steps=8, seed=0)
+    series = interval_traffic_series(tr, 100.0, 8, N)
+    assert series.shape == (8, N)
+    np.testing.assert_allclose(series[:, 0], 10.0)
+
+    # learnable signal: alternating traffic levels
+    sig = np.asarray([10, 2, 10, 2, 10, 2, 10, 2, 10, 2, 10, 2], np.float32)
+    pred = RNNTrafficPredictor(hidden=8, lr=2e-2, seed=0)
+    loss = pred.fit(sig, epochs=400)
+    assert loss < 0.05
+    nxt = pred.predict(sig[:5])      # history ends on 10 -> next ~2
+    assert nxt < 6.0
+    nxt = pred.predict(sig[:6])      # history ends on 2 -> next ~10
+    assert nxt > 6.0
