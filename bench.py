@@ -7,36 +7,165 @@ on one chip, and prints ONE JSON line:
     {"metric": "env_steps_per_sec_per_chip", "value": ..., "unit": ...,
      "vs_baseline": ...}
 
-Baseline: the reference publishes no numbers (BASELINE.md); its training loop
-is a single SimPy env + torch-geometric DDPG on one CPU core, whose
-steps/sec it logs to TensorBoard but never reports.  We use
-REFERENCE_CPU_SPS = 100 env-steps/sec as a generous order-of-magnitude
-estimate of that loop (each step simulates ~1000 SimPy events plus a GNN
-forward; the paper's training runs are hours for ~40k steps).
-``vs_baseline`` is measured_value / REFERENCE_CPU_SPS.
+Structure: a stdlib-only ORCHESTRATOR (this process) runs every JAX step in
+a child subprocess with a hard timeout, because a faulted TPU call wedges
+the shared chip and the *next* process then hangs at backend init.  The
+orchestrator (1) probes backend health with a bounded-time child, (2) runs
+the measurement worker (``--worker``) over an escalation ladder of
+(replicas, chunk) configs, and (3) keeps the best successful number.  A
+fault at one rung never poisons the artifact: the previous rung's number is
+already banked.
+
+Episodes run CHUNKED: the 200-step episode executes as several shorter
+``rollout_episodes`` device calls (carrying env state/obs/replay across
+calls).  Single 200-step scan calls (200 x 100 fused engine substeps) fault
+the TPU runtime; 25-50-step chunks are the validated operating range.
+
+Baseline: the reference publishes no numbers (BASELINE.md); its training
+loop is a single SimPy env + torch DDPG on one CPU core
+(simple_ddpg.py:271 logs SPS to TensorBoard, never reported).  The
+denominator here is MEASURED by ``tools/measure_baseline.py`` running the
+reference's own simulator step loop on this machine's CPU and stored in
+``BASELINE_MEASURED.json``; ``vs_baseline`` = measured_value / that.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-REFERENCE_CPU_SPS = 100.0
-REPLICAS = 256
-EPISODE_STEPS = 200
+EPISODE_STEPS = 200          # reference sample_agent.yaml:23
 EPISODES_MEASURED = 3
+PROBE_TIMEOUT = 240          # backend init is normally ~10 s; wedged = hang
+PROBE_RETRIES = 3
+PROBE_RETRY_SLEEP = 60
+# (replicas, chunk_steps, worker_timeout_s).  Per-call work B*chunk stays
+# near the proven-good 64x50 envelope; escalation only after a banked rung.
+LADDER = [
+    (64, 50, 900),
+    (256, 25, 900),
+    (256, 50, 900),
+]
+_FALLBACK_BASELINE_SPS = 100.0  # order-of-magnitude estimate, only used if
+                                # BASELINE_MEASURED.json is absent
 
 
-def main():
+def _repo(*parts):
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), *parts)
+
+
+def baseline_sps() -> float:
+    try:
+        with open(_repo("BASELINE_MEASURED.json")) as f:
+            return float(json.load(f)["reference_cpu_sps"])
+    except Exception:
+        return _FALLBACK_BASELINE_SPS
+
+
+# --------------------------------------------------------------- orchestrator
+def probe(timeout=PROBE_TIMEOUT) -> bool:
+    """Bounded-time backend health check in a fresh process."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print('PROBE_OK', len(d))"],
+            timeout=timeout, capture_output=True, text=True)
+        return r.returncode == 0 and "PROBE_OK" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def probe_with_retry() -> bool:
+    for i in range(PROBE_RETRIES):
+        if probe():
+            return True
+        print(f"[bench] probe {i + 1}/{PROBE_RETRIES} failed; backend "
+              f"wedged or tunnel down — sleeping {PROBE_RETRY_SLEEP}s",
+              file=sys.stderr)
+        time.sleep(PROBE_RETRY_SLEEP)
+    return False
+
+
+def run_worker(replicas, chunk, timeout):
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           str(replicas), str(chunk), str(EPISODES_MEASURED)]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] worker B={replicas} chunk={chunk}: timeout "
+              f"({timeout}s)", file=sys.stderr)
+        return None
+    sys.stderr.write(r.stderr[-2000:])
+    if r.returncode != 0:
+        print(f"[bench] worker B={replicas} chunk={chunk}: rc="
+              f"{r.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if "value" in out:
+                return out
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def orchestrate():
+    if not probe_with_retry():
+        print(json.dumps({
+            "metric": "env_steps_per_sec_per_chip", "value": 0.0,
+            "unit": "env-steps/s", "vs_baseline": 0.0,
+            "error": "TPU backend unreachable (init probe timed out after "
+                     f"{PROBE_RETRIES} attempts)"}))
+        sys.exit(1)
+    best = None
+    for replicas, chunk, timeout in LADDER:
+        out = run_worker(replicas, chunk, timeout)
+        if out is not None:
+            if best is None or out["value"] > best["value"]:
+                best = out
+            print(f"[bench] rung B={replicas} chunk={chunk}: "
+                  f"{out['value']:.1f} env-steps/s", file=sys.stderr)
+        else:
+            # failed rung may have wedged the chip; verify health before
+            # escalating further, and never risk the banked number
+            if best is not None:
+                print("[bench] rung failed with a number banked — stopping "
+                      "escalation", file=sys.stderr)
+                break
+            if not probe_with_retry():
+                break
+    if best is None:
+        print(json.dumps({
+            "metric": "env_steps_per_sec_per_chip", "value": 0.0,
+            "unit": "env-steps/s", "vs_baseline": 0.0,
+            "error": "all ladder rungs failed"}))
+        sys.exit(1)
+    print(json.dumps({
+        "metric": "env_steps_per_sec_per_chip",
+        "value": best["value"],
+        "unit": "env-steps/s",
+        "vs_baseline": round(best["value"] / baseline_sps(), 2),
+    }))
+
+
+# --------------------------------------------------------------------- worker
+def worker(replicas: int, chunk: int, episodes: int):
+    import jax
+    import jax.numpy as jnp
+
     from __graft_entry__ import _flagship
     from gsc_tpu.parallel import ParallelDDPG
-
-    env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
     from gsc_tpu.sim.traffic import generate_traffic
 
-    B = REPLICAS
+    assert EPISODE_STEPS % chunk == 0, (EPISODE_STEPS, chunk)
+    chunks_per_ep = EPISODE_STEPS // chunk
+    t_start = time.time()
+    env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
+    B = replicas
     traffic = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs),
         *[generate_traffic(env.sim_cfg, env.service, topo, EPISODE_STEPS,
@@ -48,34 +177,41 @@ def main():
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
 
-    def episode(state, buffers, env_states, obs, start_step):
-        state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
-            state, buffers, env_states, obs, topo, traffic,
-            jnp.int32(start_step))
+    def episode(state, buffers, env_states, obs, ep):
+        for c in range(chunks_per_ep):
+            start = jnp.int32(ep * EPISODE_STEPS + c * chunk)
+            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+                state, buffers, env_states, obs, topo, traffic, start, chunk)
         state, metrics = pddpg.learn_burst(state, buffers)
         return state, buffers, env_states, obs, stats, metrics
 
-    # warmup/compile
+    # warmup/compile (episode 0 is also the agent's random-action warmup)
     out = episode(state, buffers, env_states, obs, 0)
     jax.block_until_ready(out)
     state, buffers, env_states, obs = out[:4]
+    print(f"[worker] compile+warmup: {time.time() - t_start:.1f}s",
+          file=sys.stderr)
 
     t0 = time.time()
-    for ep in range(1, 1 + EPISODES_MEASURED):
-        out = episode(state, buffers, env_states, obs, ep * EPISODE_STEPS)
-        jax.block_until_ready(out)
+    for ep in range(1, 1 + episodes):
+        out = episode(state, buffers, env_states, obs, ep)
         state, buffers, env_states, obs = out[:4]
+    jax.block_until_ready(out)
     dt = time.time() - t0
 
-    env_steps = EPISODES_MEASURED * EPISODE_STEPS * B
+    env_steps = episodes * EPISODE_STEPS * B
     sps = env_steps / dt
     print(json.dumps({
         "metric": "env_steps_per_sec_per_chip",
         "value": round(sps, 1),
         "unit": "env-steps/s",
-        "vs_baseline": round(sps / REFERENCE_CPU_SPS, 2),
+        "replicas": B, "chunk": chunk,
+        "measure_wall_s": round(dt, 1),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        orchestrate()

@@ -114,15 +114,18 @@ class DDPG:
         return jax.lax.cond(warmup, lambda: random_action, policy_action)
 
     # ------------------------------------------------------------- rollout
-    @partial(jax.jit, static_argnums=0)
+    @partial(jax.jit, static_argnums=(0, 7))
     def rollout_episode(self, state: DDPGState, buffer: ReplayBuffer,
                         env_state, obs, topo, traffic,
-                        episode_start_step: jnp.ndarray
+                        episode_start_step: jnp.ndarray,
+                        num_steps: int = None
                         ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
                                    Dict[str, jnp.ndarray]]:
         """One full episode as a lax.scan: action -> env.step -> buffer.add.
         Returns (state w/ fresh rng, buffer, final_env_state, final_obs,
-        episode stats)."""
+        episode stats).  ``num_steps`` (static) overrides the scan length so
+        an episode can run as several shorter device calls (see
+        ParallelDDPG.rollout_episodes for the chunking contract)."""
         from ..env.actions import action_mask
         from ..env.permutation import ShuffleOps
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
@@ -154,9 +157,9 @@ class DDPG:
                      "avg_e2e_delay": info["avg_e2e_delay"]}
             return (env_state, next_obs, next_perm, buffer), stats
 
+        T = self.agent.episode_steps if num_steps is None else num_steps
         (env_state, obs, _, buffer), stats = jax.lax.scan(
-            step_fn, (env_state, obs, perm0, buffer),
-            jnp.arange(self.agent.episode_steps))
+            step_fn, (env_state, obs, perm0, buffer), jnp.arange(T))
         episode_stats = {
             "episodic_return": stats["reward"].sum(),
             "mean_succ_ratio": stats["succ_ratio"].mean(),

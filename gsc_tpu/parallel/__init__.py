@@ -1,6 +1,8 @@
 """Distributed scaling layer: meshes, shardings, data-parallel training."""
 from .dp import ParallelDDPG
-from .mesh import make_mesh, put_replicated, put_sharded, replicated, sharded_axis0
+from .mesh import (force_virtual_cpu, make_mesh, put_replicated,
+                   put_sharded, replicated, sharded_axis0)
 
-__all__ = ["ParallelDDPG", "make_mesh", "put_replicated", "put_sharded",
+__all__ = ["ParallelDDPG", "force_virtual_cpu", "make_mesh",
+           "put_replicated", "put_sharded",
            "replicated", "sharded_axis0"]

@@ -60,16 +60,26 @@ class ParallelDDPG:
             keys, topo, traffic)
 
     # -------------------------------------------------------------- rollout
-    @partial(jax.jit, static_argnums=0)
+    @partial(jax.jit, static_argnums=(0, 8))
     def rollout_episodes(self, state: DDPGState, buffers: ReplayBuffer,
                          env_states, obs, topo, traffic,
-                         episode_start_step) -> Tuple[
+                         episode_start_step, num_steps: int = None) -> Tuple[
                              DDPGState, ReplayBuffer, Any, Any,
                              Dict[str, jnp.ndarray]]:
         """One episode on every replica: scan over steps of a vmapped
         (action -> env.step -> buffer.add) body.  Parameters are shared
         (replicated); env state, obs, buffers and traffic carry the leading
-        [B] replica axis."""
+        [B] replica axis.
+
+        ``num_steps`` (static) overrides the scan length so an episode can be
+        split into several shorter device calls (carry env_states/obs/buffers
+        across calls, pass the global step of the chunk start as
+        ``episode_start_step``).  Long single-call scans (200 steps x 100
+        engine substeps) exceed the TPU runtime's per-call limits; 25-50-step
+        chunks are the validated operating range.  Chunked resumption assumes
+        ``shuffle_nodes`` is off (default): with shuffling on, each call
+        opens a fresh permutation frame, which is only correct at episode
+        boundaries."""
         from ..env.permutation import ShuffleOps
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
                            self.env.limits.max_sfs)
@@ -105,9 +115,9 @@ class ParallelDDPG:
                     env_states, obs, perms, buffers, traffic, keys, i)
             return (env_states, obs, perms, buffers), stats
 
+        T = self.agent.episode_steps if num_steps is None else num_steps
         (env_states, obs, _, buffers), stats = jax.lax.scan(
-            step_fn, (env_states, obs, perms0, buffers),
-            jnp.arange(self.agent.episode_steps))
+            step_fn, (env_states, obs, perms0, buffers), jnp.arange(T))
         # stats leaves: [T, B]
         episode_stats = {
             "episodic_return": stats["reward"].sum(0).mean(),

@@ -18,27 +18,48 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def force_virtual_cpu(n_devices: int) -> None:
+    """Select an ``n_devices``-device virtual CPU platform — BEFORE any
+    backend touch.
+
+    The dry-run/CI entry point: call this before the first
+    ``jax.devices()``/``jit`` of the process.  It sets
+    ``xla_force_host_platform_device_count`` and switches
+    ``jax_platforms`` to cpu via ``jax.config.update`` — the one order of
+    operations that never initializes the default (possibly TPU) backend,
+    whose init can hang indefinitely when the shared chip is wedged by an
+    earlier faulted run (tests/conftest.py uses the same pattern).  If a
+    CPU backend predating the flag is already live, falls back to
+    ``clear_backends`` surgery."""
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = " ".join(f for f in flags.split()
+                     if "xla_force_host_platform_device_count" not in f)
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n_devices:
+        jax.extend.backend.clear_backends()
+    if len(jax.devices()) < n_devices:
+        raise ValueError(
+            f"virtual CPU platform has {len(jax.devices())} devices, "
+            f"need {n_devices}")
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     """1-D mesh over the first ``n_devices`` devices (default: all).
 
     If fewer devices exist than requested, falls back to a virtual CPU
     platform with ``n_devices`` host devices (the dry-run path for
-    validating multi-chip shardings without hardware)."""
+    validating multi-chip shardings without hardware).  Note this probes
+    the current backend first; dry-run entry points that must never touch
+    the TPU should call ``force_virtual_cpu`` beforehand."""
     devs = jax.devices()
     if n_devices is not None and len(devs) < n_devices:
-        import os
-        flags = os.environ.get("XLA_FLAGS", "")
-        flags = " ".join(f for f in flags.split()
-                         if "xla_force_host_platform_device_count" not in f)
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
-        jax.config.update("jax_platforms", "cpu")
-        jax.extend.backend.clear_backends()
+        force_virtual_cpu(n_devices)
         devs = jax.devices()
     if n_devices is not None:
-        if len(devs) < n_devices:
-            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
 

@@ -27,26 +27,66 @@ def test_dryrun_multichip_8():
     ge.dryrun_multichip(8)  # raises on any sharding/compile failure
 
 
-def test_parallel_matches_manual_replica(monkeypatch):
-    """B=2 parallel rollout produces per-replica rewards identical to two
-    equal-traffic replicas (determinism across the vmap axis)."""
+def _deterministic_setup(episode_steps=2, B=2):
+    """Flagship small env with zero exploration noise + identical traffic on
+    every replica: post-warmup the policy is deterministic, so per-replica
+    trajectories must match bitwise."""
+    import dataclasses
+
     import __graft_entry__ as ge
-    env, agent, topo, traffic0 = ge._flagship(max_nodes=8, max_edges=8,
-                                              episode_steps=2, max_flows=32)
-    B = 2
-    traffic = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), traffic0)
+    env, agent, topo, traffic0 = ge._flagship(
+        max_nodes=8, max_edges=8, episode_steps=episode_steps, max_flows=32)
+    agent = dataclasses.replace(agent, rand_sigma=0.0, rand_mu=0.0)
+    env.agent = agent
+    traffic = jax.tree_util.tree_map(
+        lambda x: jnp.stack([x] * B), traffic0)
     pddpg = ParallelDDPG(env, agent, num_replicas=B)
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
+    return pddpg, state, buffers, env_states, obs, topo, traffic
+
+
+def test_parallel_matches_manual_replica():
+    """B=2 with identical traffic and a deterministic post-warmup policy:
+    the per-replica transition streams (obs, action, reward, done) must be
+    identical across the vmap axis — real cross-replica determinism, not
+    just finiteness."""
+    pddpg, state, buffers, env_states, obs, topo, traffic = \
+        _deterministic_setup(episode_steps=2)
     state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
         state, buffers, env_states, obs, topo, traffic, jnp.int32(10**6))
-    # both replicas saw identical traffic and (post-warmup) the same policy;
-    # nothing should diverge except exploration noise — which is per-replica,
-    # so just check both produced finite, populated buffers
     assert int(buffers.size[0]) == 2 and int(buffers.size[1]) == 2
+    jax.tree_util.tree_map(
+        lambda x: np.testing.assert_array_equal(np.asarray(x[0]),
+                                                np.asarray(x[1])),
+        buffers.data)
     assert np.isfinite(float(stats["episodic_return"]))
+
+
+def test_rollout_chunked_equals_straight():
+    """A 4-step episode run as 2x 2-step chunked device calls (the bench /
+    TPU operating mode — long single scans fault the chip) reproduces the
+    one-call rollout exactly: same replay contents, same final obs."""
+    pddpg, state, buffers, env_states, obs, topo, traffic = \
+        _deterministic_setup(episode_steps=4)
+    start = 10**6  # far past warmup: policy branch, zero noise
+    _, b1, es1, ob1, _ = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(start))
+    s2, b2, es2, ob2, _ = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(start), 2)
+    s2, b2, es2, ob2, _ = pddpg.rollout_episodes(
+        s2, b2, es2, ob2, topo, traffic, jnp.int32(start + 2), 2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        b1.data, b2.data)
+    np.testing.assert_array_equal(np.asarray(b1.size), np.asarray(b2.size))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        ob1, ob2)
 
 
 def test_parallel_shuffle_nodes_smoke():
