@@ -61,6 +61,9 @@ def baseline_sps() -> float:
         with open(_repo("BASELINE_MEASURED.json")) as f:
             return float(json.load(f)["reference_cpu_sps"])
     except Exception:
+        print("[bench] BASELINE_MEASURED.json missing/unreadable — "
+              f"vs_baseline uses the {_FALLBACK_BASELINE_SPS} ESTIMATE",
+              file=sys.stderr)
         return _FALLBACK_BASELINE_SPS
 
 

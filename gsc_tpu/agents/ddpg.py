@@ -114,7 +114,7 @@ class DDPG:
         return jax.lax.cond(warmup, lambda: random_action, policy_action)
 
     # ------------------------------------------------------------- rollout
-    @partial(jax.jit, static_argnums=(0, 7))
+    @partial(jax.jit, static_argnums=(0, 8))
     def rollout_episode(self, state: DDPGState, buffer: ReplayBuffer,
                         env_state, obs, topo, traffic,
                         episode_start_step: jnp.ndarray,

@@ -1,0 +1,134 @@
+"""Golden parity vs the ACTUAL reference simulator.
+
+The frozen numbers below were produced by ``tools/run_reference.py`` — the
+UNMODIFIED reference coordsim (SimPy process model) running under the
+``tools/minisimpy`` shim — via::
+
+    python tools/run_reference.py --mode interface --network <net> \
+        --steps 50 --seed 1234
+
+with the reference's own sample_config.yaml (deterministic arrivals every
+10 ms per ingress, deterministic size, run_duration 100 ms, TTL 100) and
+abc.yaml (3 x 5 ms SFs), driving the same uniform place-everywhere /
+uniform-schedule action our ``cli simulate`` uses.
+
+The jax engine must reproduce them within its documented fixed-step
+quantization bounds (gsc_tpu/sim/engine.py divergence notes):
+- generated flows: exact (deterministic arrival streams)
+- processed/dropped: within +-2 flows of the oracle (in-flight flows at
+  the horizon land on different sides of the boundary under 1 ms substeps)
+- drop-reason split: exact
+- avg e2e delay: within 2.5% relative (measured divergence: ~0.0% on
+  triangle, ~1.8% on Abilene)
+
+When the reference tree is present, ``test_oracle_numbers_are_current``
+re-runs the oracle live and checks the frozen constants themselves, so the
+oracle can't silently rot.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REFERENCE = os.environ.get("GSC_REFERENCE_DIR", "/root/reference")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE),
+    reason="reference tree not available")
+
+SERVICE = "configs/service_functions/abc.yaml"
+CONFIG = "configs/config/simulator/sample_config.yaml"
+
+# frozen oracle outputs (reference coordsim, seed 1234, 50 control steps)
+ORACLE = {
+    "triangle": {
+        "network": "configs/networks/triangle/"
+                   "triangle-in2-cap10-delay10.graphml",
+        "generated": 1000, "processed": 995, "dropped": 0,
+        "drop_reasons": {"TTL": 0, "DECISION": 0, "LINK_CAP": 0,
+                         "NODE_CAP": 0},
+        "avg_e2e": 34.48743718592965,
+    },
+    "abilene": {
+        "network": "configs/networks/abilene/"
+                   "abilene-in4-rand-cap1-2.graphml",
+        "generated": 2000, "processed": 599, "dropped": 1395,
+        "drop_reasons": {"TTL": 0, "DECISION": 0, "LINK_CAP": 0,
+                         "NODE_CAP": 1395},
+        "avg_e2e": 38.51419031719533,
+    },
+}
+STEPS = 50
+SEED = 1234
+
+
+def _run_engine(network_rel):
+    """The cli-simulate path, in-process: uniform schedule over real nodes,
+    everything placed everywhere, 50 x 100 ms control intervals."""
+    from gsc_tpu.config.loader import load_service, load_sim
+    from gsc_tpu.config.schema import DROP_REASONS, EnvLimits
+    from gsc_tpu.sim.engine import SimEngine
+    from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.topology.compiler import load_topology
+
+    svc = load_service(os.path.join(REFERENCE, SERVICE))
+    sim_cfg = load_sim(os.path.join(REFERENCE, CONFIG))
+    limits = EnvLimits.for_service(svc, max_nodes=24, max_edges=37)
+    topo = load_topology(os.path.join(REFERENCE, network_rel),
+                         max_nodes=24, max_edges=37, seed=SEED)
+    traffic = generate_traffic(sim_cfg, svc, topo, STEPS, SEED)
+    engine = SimEngine(svc, sim_cfg, limits)
+    nm = np.asarray(topo.node_mask)
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, nm] = 1.0 / nm.sum()
+    placement = jnp.asarray(np.broadcast_to(nm[:, None], (24, 3)).copy())
+    state = engine.init(jax.random.PRNGKey(SEED), topo)
+    for _ in range(STEPS):
+        state, metrics = engine.apply(state, topo, traffic,
+                                      jnp.asarray(sched), placement)
+    return {
+        "generated": int(metrics.generated),
+        "processed": int(metrics.processed),
+        "dropped": int(metrics.dropped),
+        "drop_reasons": {k: int(v) for k, v in
+                         zip(DROP_REASONS, np.asarray(metrics.drop_reasons))},
+        "avg_e2e": float(metrics.avg_e2e()),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(ORACLE.keys()))
+def test_engine_matches_reference(name):
+    want = ORACLE[name]
+    got = _run_engine(want["network"])
+    assert got["generated"] == want["generated"]
+    assert abs(got["processed"] - want["processed"]) <= 2, (got, want)
+    assert abs(got["dropped"] - want["dropped"]) <= 2, (got, want)
+    assert got["drop_reasons"] == want["drop_reasons"]
+    assert got["avg_e2e"] == pytest.approx(want["avg_e2e"], rel=0.025)
+
+
+@pytest.mark.parametrize("name", sorted(ORACLE.keys()))
+def test_oracle_numbers_are_current(name):
+    """Re-run the reference itself and verify the frozen constants."""
+    want = ORACLE[name]
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # skip TPU registration: no jax
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_reference.py"),
+         "--mode", "interface", "--network", want["network"],
+         "--steps", str(STEPS), "--seed", str(SEED)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["generated_flows"] == want["generated"]
+    assert out["processed_flows"] == want["processed"]
+    assert out["dropped_flows"] == want["dropped"]
+    assert out["dropped_by_reason"] == want["drop_reasons"]
+    assert out["avg_end2end_delay"] == pytest.approx(want["avg_e2e"],
+                                                     rel=1e-9)

@@ -1,0 +1,66 @@
+"""Measure the reference's per-control-step rate on this machine's CPU and
+write BASELINE_MEASURED.json — the denominator for bench.py's vs_baseline.
+
+What is measured: the reference's own adapter loop — siminterface.Simulator
+init + N x apply(uniform action) (siminterface/simulator.py:125-231) on the
+flagship scenario (Abilene in4-rand-cap1-2, abc 3-SF chain,
+sample_config.yaml: 200 steps x 100 ms runs — BASELINE.md workload row).
+This is the reference ENV-PHYSICS cost only; its real training loop adds a
+torch GNN forward per step plus a 200-gradient-step burst per episode
+(simple_ddpg.py:280-329), so the recorded steps/sec OVERSTATES the
+reference's end-to-end SPS and vs_baseline is conservative.
+
+(The full reference training loop is not runnable in this image:
+torch_geometric / gym / stable_baselines3 are not installed, and installs
+are prohibited.  The simulator loop runs unmodified via tools/minisimpy.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+NETWORK = "configs/networks/abilene/abilene-in4-rand-cap1-2.graphml"
+STEPS = 200
+REPEATS = 3
+
+
+def main():
+    rates = []
+    runs = []
+    for seed in range(REPEATS):
+        r = subprocess.run(
+            [sys.executable, os.path.join(HERE, "run_reference.py"),
+             "--mode", "interface", "--network", NETWORK,
+             "--steps", str(STEPS), "--seed", str(1234 + seed)],
+            capture_output=True, text=True, timeout=900)
+        r.check_returncode()
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        rates.append(out["steps_per_sec"])
+        runs.append(out)
+    result = {
+        "reference_cpu_sps": round(statistics.median(rates), 2),
+        "what": "siminterface init+apply loop (env physics only, no NN) "
+                "on the flagship Abilene scenario; overstates the "
+                "reference's full training-loop SPS, so vs_baseline is "
+                "conservative",
+        "network": NETWORK,
+        "steps_per_run": STEPS,
+        "repeats": REPEATS,
+        "all_rates": rates,
+        "sample_run": {k: runs[0][k] for k in
+                       ("generated_flows", "processed_flows",
+                        "dropped_flows", "avg_end2end_delay")},
+    }
+    path = os.path.join(REPO, "BASELINE_MEASURED.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""Minimal clean-room implementation of the simpy API surface the reference
+coordsim uses, so the reference simulator can run UNMODIFIED in this image
+(simpy is not installed and cannot be installed) for golden-parity checks
+and baseline measurement.
+
+Implemented from simpy's documented semantics — not from simpy source:
+- ``Environment``: ``now``, ``step()``, ``run(until=None|number|event)``,
+  ``process(gen)``, ``timeout(delay, value=None)``, ``event()``
+- ``Process``: yieldable, resumes parent with the generator's return value
+- ``Event``: ``succeed(value=None)``, yieldable
+- event ordering: ``(time, priority, insertion_id)`` — process-init events
+  are URGENT (priority 0), timeouts / succeeded events / process
+  completions are NORMAL (priority 1), ties broken FIFO — matching simpy's
+  scheduling rules so same-timestamp behavior is reproduced.
+
+Usage: ``sys.modules["simpy"] = tools.minisimpy`` before importing any
+reference module (see run_reference.py).
+"""
+from __future__ import annotations
+
+from heapq import heappop, heappush
+from itertools import count
+
+URGENT = 0
+NORMAL = 1
+_PENDING = object()
+
+
+class Event:
+    """A one-shot event; processes waiting on it resume when it fires."""
+
+    def __init__(self, env: "Environment"):
+        self.env = env
+        self.callbacks = []          # None once processed
+        self._value = _PENDING
+
+    @property
+    def triggered(self) -> bool:
+        return self._value is not _PENDING
+
+    @property
+    def processed(self) -> bool:
+        return self.callbacks is None
+
+    @property
+    def value(self):
+        return None if self._value is _PENDING else self._value
+
+    def succeed(self, value=None) -> "Event":
+        if self._value is not _PENDING:
+            raise RuntimeError(f"{self!r} has already been triggered")
+        self._value = value
+        self.env._schedule(self, NORMAL)
+        return self
+
+
+class Timeout(Event):
+    def __init__(self, env, delay, value=None):
+        if delay < 0:
+            raise ValueError(f"negative delay {delay}")
+        super().__init__(env)
+        self._value = value
+        env._schedule(self, NORMAL, delay)
+
+
+class Process(Event):
+    """Wraps a generator; each yielded event schedules the next resumption.
+    The Process itself is an Event that fires (with the generator's return
+    value) when the generator finishes."""
+
+    def __init__(self, env, generator):
+        super().__init__(env)
+        self._generator = generator
+        init = Event(env)
+        init._value = None
+        init.callbacks.append(self._resume)
+        env._schedule(init, URGENT)
+
+    def _resume(self, event: Event) -> None:
+        while True:
+            try:
+                target = self._generator.send(event.value)
+            except StopIteration as stop:
+                self._value = stop.value
+                self.env._schedule(self, NORMAL)
+                return
+            if not isinstance(target, Event):
+                raise RuntimeError(
+                    f"process yielded a non-event: {target!r}")
+            if target.callbacks is not None:
+                target.callbacks.append(self._resume)
+                return
+            # target already processed -> resume immediately, same timestep
+            event = target
+
+
+class Environment:
+    def __init__(self, initial_time=0):
+        self._now = initial_time
+        self._queue = []             # heap of (time, priority, eid, event)
+        self._eid = count()
+
+    @property
+    def now(self):
+        return self._now
+
+    # ------------------------------------------------------------- factories
+    def event(self) -> Event:
+        return Event(self)
+
+    def timeout(self, delay, value=None) -> Timeout:
+        return Timeout(self, delay, value)
+
+    def process(self, generator) -> Process:
+        return Process(self, generator)
+
+    # ------------------------------------------------------------- execution
+    def _schedule(self, event: Event, priority: int, delay=0) -> None:
+        heappush(self._queue,
+                 (self._now + delay, priority, next(self._eid), event))
+
+    def step(self) -> None:
+        """Process the single next event."""
+        t, _, _, event = heappop(self._queue)
+        self._now = t
+        callbacks, event.callbacks = event.callbacks, None
+        for cb in callbacks:
+            cb(event)
+
+    def peek(self):
+        return self._queue[0][0] if self._queue else float("inf")
+
+    def run(self, until=None):
+        if until is None:
+            while self._queue:
+                self.step()
+            return None
+        if isinstance(until, Event):
+            if until.processed:
+                return until.value
+            fired = []
+            until.callbacks.append(fired.append)
+            while not fired:
+                if not self._queue:
+                    raise RuntimeError(
+                        "no scheduled events left but until event is "
+                        "still pending")
+                self.step()
+            return until.value
+        at = until
+        if at <= self._now:
+            raise ValueError(
+                f"until ({at}) must be greater than now ({self._now})")
+        stop = Event(self)
+        stop._value = None
+        self._schedule(stop, URGENT, at - self._now)
+        while self._queue:
+            if self._queue[0][3] is stop:
+                heappop(self._queue)
+                self._now = at
+                return None
+            self.step()
+        return None

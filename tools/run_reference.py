@@ -1,0 +1,206 @@
+"""Run the UNMODIFIED reference simulator (/root/reference) under the
+minisimpy shim, and dump its metrics as JSON.
+
+Two modes:
+- ``standalone``: the reference's coordsim/main.py path (dummy triangle
+  placement/schedule, FlowSimulator driven directly) — reference
+  coordsim/main.py:19-66.
+- ``interface``: the RL-facing adapter loop (siminterface.Simulator
+  init + N x apply with a uniform SimulatorAction) — the exact per-control-
+  step loop the reference agent drives (siminterface/simulator.py:125-231,
+  controller/duration_controller.py:36-80).  This is both the golden-parity
+  oracle and the baseline step-rate denominator.
+
+The reference tree is used READ-ONLY via sys.path; nothing is copied.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REFERENCE = os.environ.get("GSC_REFERENCE_DIR", "/root/reference")
+
+
+def _install_shim():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import minisimpy
+    sys.modules["simpy"] = minisimpy
+    # geopy is not installed either; the reader only needs
+    # geopy.distance.distance(a, b).km (reader.py:11, 216-227).  We back it
+    # with the same haversine great-circle formula gsc_tpu's topology
+    # compiler uses, so parity comparisons isolate ENGINE semantics — the
+    # haversine-vs-geodesic delta (<0.5% of link delay) is the documented
+    # divergence from true upstream (gsc_tpu/topology/compiler.py:9-14).
+    import math
+    import types
+
+    class _Dist:
+        def __init__(self, a, b):
+            (lat1, lon1), (lat2, lon2) = a, b
+            r = 6371008.8
+            p1, p2 = math.radians(lat1), math.radians(lat2)
+            dp, dl = p2 - p1, math.radians(lon2 - lon1)
+            h = (math.sin(dp / 2) ** 2 +
+                 math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2)
+            self.meters = 2 * r * math.asin(math.sqrt(h))
+            self.km = self.meters / 1000.0
+
+    geopy = types.ModuleType("geopy")
+    geopy.distance = types.ModuleType("geopy.distance")
+    geopy.distance.distance = _Dist
+    sys.modules["geopy"] = geopy
+    sys.modules["geopy.distance"] = geopy.distance
+    # the reference's plugin packages (coordsim/forwarders/__init__.py etc.)
+    # use the pre-3.12 loader.find_module().load_module() API; restore a
+    # compat shim on this interpreter (3.12 removed find_module)
+    import importlib.machinery as _mach
+
+    if not hasattr(_mach.FileFinder, "find_module"):
+        def _find_module(self, name, path=None):
+            spec = self.find_spec(name)
+            return spec.loader if spec is not None else None
+        _mach.FileFinder.find_module = _find_module
+    if not hasattr(_mach.SourceFileLoader, "load_module"):
+        import importlib.util as _util
+
+        def _load_module(self, name):
+            if name in sys.modules:
+                return sys.modules[name]
+            spec = _util.spec_from_loader(name, self)
+            mod = _util.module_from_spec(spec)
+            sys.modules[name] = mod
+            self.exec_module(mod)
+            return mod
+        _mach.SourceFileLoader.load_module = _load_module
+    sys.path.insert(0, REFERENCE)
+
+
+def uniform_action(network, sfc_list, sf_list):
+    """Uniform schedule + place-everything action, the same 'dummy agent'
+    our cli simulate uses (spinterface SimulatorAction schema:
+    placement {node: [sf]}, scheduling {node: {sfc: {sf: {node: w}}}})."""
+    from spinterface import SimulatorAction
+    nodes = list(network.nodes.keys())
+    n = len(nodes)
+    placement = {v: list(sf_list.keys()) for v in nodes}
+    scheduling = {
+        v: {sfc: {sf: {u: 1.0 / n for u in nodes}
+                  for sf in sf_list.keys()}
+            for sfc in sfc_list.keys()}
+        for v in nodes}
+    return SimulatorAction(placement, scheduling)
+
+
+def run_interface(network_file, service_file, config_file, steps, seed):
+    from siminterface import Simulator
+
+    sim = Simulator(os.path.join(REFERENCE, network_file),
+                    os.path.join(REFERENCE, service_file),
+                    os.path.join(REFERENCE, config_file),
+                    test_mode=False)
+    t_init0 = time.time()
+    sim.init(seed)
+    init_s = time.time() - t_init0
+    action = uniform_action(sim.network, sim.sfc_list, sim.sf_list)
+    t0 = time.time()
+    for _ in range(steps):
+        sim.apply(action)
+    apply_s = time.time() - t0
+    m = sim.params.metrics.metrics
+    out = {
+        "mode": "interface",
+        "network": network_file,
+        "steps": steps,
+        "seed": seed,
+        "sim_now": float(sim.env.now),
+        "init_wall_s": round(init_s, 4),
+        "apply_wall_s": round(apply_s, 4),
+        "steps_per_sec": round(steps / apply_s, 2) if apply_s else None,
+        "generated_flows": int(m["generated_flows"]),
+        "processed_flows": int(m["processed_flows"]),
+        "dropped_flows": int(m["dropped_flows"]),
+        "total_active_flows": int(m["total_active_flows"]),
+        "avg_end2end_delay": float(m["avg_end2end_delay"]),
+        "dropped_by_reason": {k: int(v) for k, v in
+                              m["dropped_flow_reasons"].items()},
+    }
+    return out
+
+
+def run_standalone(network_file, service_file, config_file, duration, seed):
+    """coordsim/main.py:19-66 equivalent, programmatic (same objects, same
+    order) so we can choose network/duration without CLI quirks."""
+    import random
+
+    import numpy
+    import simpy
+
+    import coordsim.network.dummy_data as dummy_data
+    from coordsim.metrics.metrics import Metrics
+    from coordsim.reader import reader
+    from coordsim.simulation.flowsimulator import FlowSimulator
+    from coordsim.simulation.simulatorparams import SimulatorParams
+
+    import logging
+    log = logging.getLogger("run_reference")
+    env = simpy.Environment()
+    random.seed(seed)
+    numpy.random.seed(seed)
+    network, ing, eg = reader.read_network(
+        os.path.join(REFERENCE, network_file), node_cap=10, link_cap=10)
+    sfc_list = reader.get_sfc(os.path.join(REFERENCE, service_file))
+    sf_list = reader.get_sf(os.path.join(REFERENCE, service_file), "")
+    config = reader.get_config(os.path.join(REFERENCE, config_file))
+    metrics = Metrics(network, sf_list)
+    params = SimulatorParams(
+        log, network, ing, eg, sfc_list, sf_list, config, metrics,
+        sf_placement=dummy_data.triangle_placement,
+        schedule=dummy_data.triangle_schedule)
+    sim = FlowSimulator(env, params)
+    sim.start()
+    t0 = time.time()
+    env.run(until=duration)
+    wall = time.time() - t0
+    m = metrics.metrics
+    return {
+        "mode": "standalone", "network": network_file,
+        "duration": duration, "seed": seed, "wall_s": round(wall, 4),
+        "generated_flows": int(m["generated_flows"]),
+        "processed_flows": int(m["processed_flows"]),
+        "dropped_flows": int(m["dropped_flows"]),
+        "avg_end2end_delay": float(m["avg_end2end_delay"]),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["interface", "standalone"],
+                    default="interface")
+    ap.add_argument("--network",
+                    default="configs/networks/triangle/"
+                            "triangle-in2-cap10-delay10.graphml")
+    ap.add_argument("--service",
+                    default="configs/service_functions/abc.yaml")
+    ap.add_argument("--config",
+                    default="configs/config/simulator/sample_config.yaml")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--duration", type=int, default=20000)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+    _install_shim()
+    import logging
+    logging.basicConfig(level=logging.ERROR)
+    if args.mode == "interface":
+        out = run_interface(args.network, args.service, args.config,
+                            args.steps, args.seed)
+    else:
+        out = run_standalone(args.network, args.service, args.config,
+                             args.duration, args.seed)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
