@@ -122,23 +122,50 @@ class ServiceTables:
                    resource_fns=tuple(fns))
 
 
+def _group_order(cell_id: jnp.ndarray) -> jnp.ndarray:
+    """Permutation sorting flows by (cell, slot) — groups each cell's flows
+    contiguously in slot order.  Keys are made unique with the slot index,
+    so no stability assumption is needed.  O(M log M) work on [M] vectors,
+    replacing the former [M, num_cells] one-hot cumsum matrices that scaled
+    as O(M*N*S) / O(M*E) per substep and throttled 64-200-node topologies
+    (BASELINE ladder rungs 4-5)."""
+    m = cell_id.shape[0]
+    return jnp.argsort(cell_id * m + jnp.arange(m))
+
+
+def _run_starts(sorted_cell: jnp.ndarray) -> jnp.ndarray:
+    """For each sorted position, the first position of its cell's run."""
+    idx = jnp.arange(sorted_cell.shape[0])
+    new = jnp.concatenate([jnp.ones((1,), bool),
+                           sorted_cell[1:] != sorted_cell[:-1]])
+    return jax.lax.cummax(jnp.where(new, idx, 0))
+
+
 def _rank_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
                   num_cells: int) -> jnp.ndarray:
-    """rank[m] = #(flows m'<m with mask and same cell).  [M] i32."""
-    onehot = (cell_id[:, None] == jnp.arange(num_cells)[None, :]) & mask[:, None]
-    prefix = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
-    return jnp.take_along_axis(
-        prefix, jnp.clip(cell_id, 0)[:, None], axis=1)[:, 0] - 1
+    """rank[m] = #(flows m'<m with mask and same cell).  [M] i32.
+    Only meaningful under ``mask`` (masked-out flows rank in a sentinel
+    cell)."""
+    m = cell_id.shape[0]
+    key = jnp.where(mask, cell_id, num_cells)
+    order = _group_order(key)
+    starts = _run_starts(key[order])
+    rank_sorted = (jnp.arange(m) - starts).astype(jnp.int32)
+    return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
 
 
 def _prefix_sum_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
                         vals: jnp.ndarray, num_cells: int) -> jnp.ndarray:
-    """Inclusive per-cell prefix sum of vals over masked flows, in slot order."""
-    onehot = (cell_id[:, None] == jnp.arange(num_cells)[None, :]) & mask[:, None]
-    contrib = jnp.where(onehot, vals[:, None], 0.0)
-    prefix = jnp.cumsum(contrib, axis=0)
-    return jnp.take_along_axis(
-        prefix, jnp.clip(cell_id, 0)[:, None], axis=1)[:, 0]
+    """Inclusive per-cell prefix sum of masked vals, in slot order; every
+    slot (masked or not) reads its cell's prefix at its own position."""
+    del num_cells
+    m = cell_id.shape[0]
+    order = _group_order(cell_id)
+    v = jnp.where(mask, vals, 0.0)[order]
+    cs = jnp.cumsum(v)
+    starts = _run_starts(cell_id[order])
+    prefix_sorted = cs - (cs[starts] - v[starts])
+    return jnp.zeros(m, vals.dtype).at[order].set(prefix_sorted)
 
 
 class SimEngine:
@@ -492,23 +519,30 @@ class SimEngine:
             num_proc_delay=m.num_proc_delay + n_want,
         )
         # node capacity admission via resource functions, greedy slot order
-        # (request_resources, base_processor.py:51-101)
-        ns_cell = node * self.S + sf_now
+        # (request_resources, base_processor.py:51-101).  Every candidate
+        # sees the base load plus the same-substep admitted drs of flows
+        # m'<=m at its node, per SF column: one (node, slot) grouping reused
+        # across refinement iters, with S [M]-cumsums per iter — no
+        # [M, N*S] materialization.
+        node_order = _group_order(node)
+        node_sorted = node[node_order]
+        starts_node = _run_starts(node_sorted)
+        base_load_mine = node_load[node]                       # [M,S]
+        avail_mine = sf_available[node]                        # [M,S]
+        cap_mine = cap_now[node]
         admitted_n = want
         demanded = jnp.zeros(self.M, jnp.float32)
         for _ in range(self.cfg.admission_iters):
-            # per-(node, SF) inclusive prefix of admitted same-substep drs
-            onehot = (ns_cell[:, None] == jnp.arange(self.N * self.S)[None, :]) \
-                & admitted_n[:, None]
-            prefix_ns = jnp.cumsum(
-                jnp.where(onehot, dr[:, None], 0.0), axis=0
-            ).reshape(self.M, self.N, self.S)
-            load_plus = node_load[None] + prefix_ns            # [M,N,S]
-            load_mine = jnp.take_along_axis(
-                load_plus, node[:, None, None], axis=1)[:, 0]  # [M,S]
-            avail_mine = sf_available[node]                    # [M,S]
+            cols = []
+            for s in range(self.S):
+                v = jnp.where(admitted_n & (sf_now == s), dr, 0.0)[node_order]
+                cs = jnp.cumsum(v)
+                pref_sorted = cs - (cs[starts_node] - v[starts_node])
+                cols.append(jnp.zeros(self.M, dr.dtype)
+                            .at[node_order].set(pref_sorted))
+            load_mine = base_load_mine + jnp.stack(cols, axis=-1)  # [M,S]
             demanded = self._demanded(load_mine, avail_mine)
-            admitted_n = want & (demanded <= cap_now[node] + _EPS)
+            admitted_n = want & (demanded <= cap_mine + _EPS)
         drop_nodecap = want & ~admitted_n
         add_n = jnp.where(admitted_n, dr, 0.0)
         node_load = node_load.at[
