@@ -64,7 +64,7 @@ def init_configs(out: str):
             "actor_hidden_layer_nodes": [256],
             "critic_hidden_layer_nodes": [64],
             "mem_limit": 10000, "batch_size": 100,
-            "nb_steps_warmup_critic": 200, "nb_steps_warmup_actor": 200,
+            "nb_steps_warmup_critic": 200,
             "rand_mu": 0.0, "rand_sigma": 0.3,
             "gamma": 0.99, "target_model_update": 1.0e-4,
             "learning_rate": 1.0e-3,
@@ -229,14 +229,29 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges):
 
     nm = np.asarray(topo.node_mask)
     n_real = int(nm.sum())
-    sched = np.zeros(limits.scheduling_shape, np.float32)
-    sched[:, :, :, nm] = 1.0 / n_real
-    placement = jnp.asarray(np.broadcast_to(nm[:, None],
-                                            (max_nodes, limits.max_sfs)))
     state = engine.init(jax.random.PRNGKey(seed), topo)
-    for _ in range(steps):
-        state, metrics = engine.apply(state, topo, traffic,
-                                      jnp.asarray(sched), placement)
+    if sim_cfg.controller == "per_flow":
+        # FlowController granularity (flow_controller.py:21-92): each
+        # deciding flow gets an individual destination every substep.  The
+        # smoke-run policy processes locally (place-on-decision installs the
+        # SF at the flow's node); idle instances are GC'd after vnf_timeout.
+        from .sim.state import PH_DECIDE
+
+        def decide_local(st):
+            deciding = st.flows.phase == PH_DECIDE
+            return jnp.where(deciding, st.flows.node, -1)
+
+        for _ in range(steps):
+            state, metrics = engine.apply_per_flow(state, topo, traffic,
+                                                   decide_local)
+    else:
+        sched = np.zeros(limits.scheduling_shape, np.float32)
+        sched[:, :, :, nm] = 1.0 / n_real
+        placement = jnp.asarray(np.broadcast_to(nm[:, None],
+                                                (max_nodes, limits.max_sfs)))
+        for _ in range(steps):
+            state, metrics = engine.apply(state, topo, traffic,
+                                          jnp.asarray(sched), placement)
     m = metrics
     click.echo(json.dumps({
         "total_flows": int(m.generated), "successful_flows": int(m.processed),

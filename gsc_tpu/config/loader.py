@@ -86,7 +86,7 @@ def load_sim(path: str, **overrides) -> SimConfig:
         kw["trace_path"] = cfg["trace_path"]
     if "prediction" in cfg:
         kw["prediction"] = bool(cfg["prediction"])
-    for key in ("max_flows", "release_horizon", "max_arrivals_per_run",
+    for key in ("max_flows", "release_horizon",
                 "admission_iters", "wrr_rank_levels"):
         if key in cfg:
             kw[key] = int(cfg[key])

@@ -154,10 +154,13 @@ class SimConfig:
     # siminterface/simulator.py:47 + traffic_predictor.py:22-56)
     prediction: bool = False
 
-    # Component registry keys (replaces eval()-resolved class name strings,
-    # reference: simulatorparams.py:29-38).
-    decision_maker: str = "wrr"          # weighted-round-robin (default_decision_maker.py)
-    controller: str = "duration"         # duration | per_flow (controller/)
+    # Control granularity (replaces the eval()-resolved controller_class,
+    # siminterface/simulator.py:130): "duration" = one (placement, schedule)
+    # action per interval (DurationController); "per_flow" = per-flow
+    # destination decisions with place-on-decision + idle-VNF GC
+    # (FlowController).  The external decision-maker semantics
+    # (external_decision_maker.py) are the per_flow path's ext_decisions.
+    controller: str = "duration"
 
     # --- TPU engine parameters (new; no reference analogue) ---
     # Substep quantum in ms for the fixed-step lax.scan engine.  The reference
@@ -168,8 +171,6 @@ class SimConfig:
     max_flows: int = 128
     # Ring-buffer horizon (in substeps) for delayed capacity release.
     release_horizon: int = 256
-    # Max arrivals buffered per ingress per control interval.
-    max_arrivals_per_run: int = 64
     # Iterations of the monotone greedy-admission refinement (within-substep
     # sequential capacity-admission semantics).
     admission_iters: int = 3
@@ -200,7 +201,8 @@ class AgentConfig:
     """
 
     observation_space: Tuple[str, ...] = ("ingress_traffic", "node_load", "node_cap")
-    link_observation_space: Tuple[str, ...] = ("delay", "link_load")
+    # (the reference also parses link_observation_space, but its only
+    # consumer is commented out, environment_limits.py:88 — not carried)
     graph_mode: bool = True
     shuffle_nodes: bool = False
     episode_steps: int = 200
@@ -212,9 +214,7 @@ class AgentConfig:
     gnn_num_iter: int = 2
     gnn_aggr: str = "mean"
     actor_hidden_layer_nodes: Tuple[int, ...] = (256,)
-    actor_hidden_layer_activation: str = "relu"
     critic_hidden_layer_nodes: Tuple[int, ...] = (64,)
-    critic_hidden_layer_activation: str = "relu"
 
     # objective / reward (reference: gym_env.py:300-380)
     objective: str = "weighted"
@@ -228,21 +228,26 @@ class AgentConfig:
 
     # replay / exploration / optimization (reference: sample_agent.yaml:38-65)
     mem_limit: int = 10000
-    rand_theta: float = 0.15
     rand_mu: float = 0.0
     rand_sigma: float = 0.3
+    # single warmup horizon: the reference only ever consumes
+    # nb_steps_warmup_critic (simple_ddpg.py:183, 308); the *_actor twin in
+    # its sample yaml is dead and not carried
     nb_steps_warmup_critic: int = 200
-    nb_steps_warmup_actor: int = 200
     gamma: float = 0.99
     target_model_update: float = 1e-4
     learning_rate: float = 1e-3
-    learning_rate_decay: float = 1e-3
     batch_size: int = 100
 
     # action post-processing (reference: simple_ddpg.py:130-131)
     schedule_threshold: float = 0.1
 
     def __post_init__(self):
+        # the reference's agent_type dispatch (main.py:374-381) is broken
+        # upstream (SAC_Agent is never defined); here unknown types fail fast
+        if self.agent_type != "DDPG":
+            raise ValueError(
+                f"unsupported agent_type {self.agent_type!r} (only DDPG)")
         if self.gnn_num_layers < 1 or self.gnn_num_iter < 1:
             raise ValueError("gnn_num_layers and gnn_num_iter must be >= 1")
         if self.objective not in SUPPORTED_OBJECTIVES:

@@ -226,6 +226,9 @@ class SimEngine:
             schedule=schedule,
             sf_available=available,
             sf_startup=jnp.where(newly, state.t, state.sf_startup),
+            # fresh instances start their idle clock now ('last_active':
+            # env.now at creation, duration_controller.py:55-59)
+            sf_last_active=jnp.where(newly, state.t, state.sf_last_active),
             # run metrics reset at interval start (writer.py:222-225)
             metrics=state.metrics.reset_run(),
         )
@@ -369,6 +372,10 @@ class SimEngine:
         hop_next = F.hop_next
         n_spawn = spawn.sum()
         cursor = state.cursor + n_spawn
+        # arrivals spawning after their scheduled substep were delayed by
+        # slot exhaustion / the per-substep arrival budget — count each once
+        late = spawn & (traffic.arr_time[cand_c] < t - _EPS)
+        truncated = state.truncated_arrivals + late.sum()
         m = m.replace(
             generated=m.generated + n_spawn,
             run_generated=m.run_generated + n_spawn,
@@ -401,6 +408,7 @@ class SimEngine:
         sf_now = jnp.clip(sf_now, 0)
         placed = state.placed
         sf_startup = state.sf_startup
+        sf_last_active = state.sf_last_active
         if ext_decisions is None:
             # requested-traffic metric for every WRR decision, before the
             # schedule lookup (add_requesting_flow,
@@ -449,6 +457,7 @@ class SimEngine:
             placed = placed | newly_placed
             fresh = newly_placed & ~sf_available
             sf_startup = jnp.where(fresh, t, sf_startup)
+            sf_last_active = jnp.where(newly_placed, t, sf_last_active)
             sf_available = sf_available | newly_placed
         dest = jnp.where(to_eg, egress, dest)
 
@@ -621,6 +630,18 @@ class SimEngine:
         gone = depart | any_drop
         phase = jnp.where(gone, PH_FREE, phase)
 
+        # idle-VNF bookkeeping: instances with load refresh last_active; in
+        # per-flow control mode instances idle past vnf_timeout are removed
+        # (update_vnf_active_status, flow_controller.py:94-112 — the
+        # reference only garbage-collects under FlowController)
+        active_sf = node_load > _EPS
+        sf_last_active = jnp.where(active_sf, t, sf_last_active)
+        if self.cfg.controller == "per_flow":
+            expire = sf_available & ~active_sf & (
+                sf_last_active < t - self.cfg.vnf_timeout)
+            sf_available = sf_available & ~expire
+            placed = placed & ~expire
+
         flows = FlowTable(phase=phase, sfc=sfc, position=position, node=node,
                           dest=dest, hop_next=hop_next, egress=egress, dr=dr,
                           duration=duration, ttl=ttl, e2e=e2e,
@@ -629,5 +650,7 @@ class SimEngine:
             t=t + dt, flows=flows, cursor=cursor, node_load=node_load,
             sf_available=sf_available, edge_used=edge_used,
             placed=placed, sf_startup=sf_startup,
+            sf_last_active=sf_last_active,
             rel_node=rel_node, rel_edge=rel_edge, metrics=m, rng=rng,
+            truncated_arrivals=truncated,
         )

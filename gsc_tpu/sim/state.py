@@ -198,6 +198,8 @@ class SimState:
     node_load: jnp.ndarray    # [N,S] f32 current processed load
     sf_available: jnp.ndarray  # [N,S] bool placed or still draining
     sf_startup: jnp.ndarray   # [N,S] f32 startup_time of the instance
+    sf_last_active: jnp.ndarray  # [N,S] f32 last time the instance had load
+                                 #     ('last_active', flow_controller.py:94-112)
     placed: jnp.ndarray       # [N,S] bool current placement action
     schedule: jnp.ndarray     # [N,C,S,N] f32 current scheduling weights
     edge_used: jnp.ndarray    # [E] f32 in-flight dr per undirected edge
@@ -206,7 +208,12 @@ class SimState:
     rel_edge: jnp.ndarray     # [H,E] f32
     metrics: SimMetrics
     rng: jnp.ndarray          # PRNG key
-    truncated_arrivals: jnp.ndarray  # [] i32 arrivals lost to slot exhaustion
+    # Arrivals admitted LATER than their scheduled substep because every
+    # flow slot (or the per-substep arrival budget) was taken — the
+    # engine's visible divergence signal from the reference's unbounded
+    # concurrent-flow model.  Each delayed arrival is counted once, when it
+    # finally spawns; surfaced by utils.debug.check_invariants.
+    truncated_arrivals: jnp.ndarray  # [] i32
 
 
 def init_state(rng, max_flows: int, n: int, c: int, s: int, e: int,
@@ -219,6 +226,7 @@ def init_state(rng, max_flows: int, n: int, c: int, s: int, e: int,
         node_load=jnp.zeros((n, s), jnp.float32),
         sf_available=jnp.zeros((n, s), bool),
         sf_startup=jnp.zeros((n, s), jnp.float32),
+        sf_last_active=jnp.zeros((n, s), jnp.float32),
         placed=jnp.zeros((n, s), bool),
         schedule=jnp.zeros((n, c, s, n), jnp.float32),
         edge_used=jnp.zeros(e, jnp.float32),

@@ -65,6 +65,13 @@ def check_invariants(state: SimState, topo: Topology,
             f"table={int(active.sum())}")
     if int(m.dropped) != int(np.asarray(m.drop_reasons).sum()):
         errs.append("drop_reasons do not sum to dropped")
+    trunc = int(np.asarray(state.truncated_arrivals))
+    if trunc > 0:
+        # not state corruption, but a visible divergence from the
+        # reference's unbounded concurrent-flow model: raise max_flows (or
+        # _ARRIVALS_PER_SUBSTEP) to restore exact arrival timing
+        errs.append(
+            f"{trunc} arrivals admitted late (flow-table slot exhaustion)")
     return errs
 
 

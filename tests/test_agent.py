@@ -42,7 +42,7 @@ def make_stack(episode_steps=4, warmup=4, graph_mode=True):
     limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
     agent = AgentConfig(
         graph_mode=graph_mode, episode_steps=episode_steps,
-        nb_steps_warmup_critic=warmup, nb_steps_warmup_actor=warmup,
+        nb_steps_warmup_critic=warmup,
         gnn_features=8, actor_hidden_layer_nodes=(16,),
         critic_hidden_layer_nodes=(16,), mem_limit=64, batch_size=4,
         objective="prio-flow")

@@ -119,3 +119,117 @@ def test_jitted_per_flow_policy(stack):
     # happens at the *start* of the next interval, not after the last substep)
     assert int(m1.run_generated) == 10
     assert int(metrics.run_generated) == 10
+
+
+def test_vnf_timeout_garbage_collection():
+    """Idle instances are removed after vnf_timeout in per-flow mode
+    (update_vnf_active_status, flow_controller.py:94-112): a placed-on-
+    decision SF whose load drained stays available only until its idle
+    clock exceeds the timeout."""
+    service = make_service()
+    limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
+    cfg = SimConfig(ttl_choices=(1000.0,), controller="per_flow",
+                    vnf_timeout=30.0, inter_arrival_mean=1000.0)
+    engine = SimEngine(service, cfg, limits)
+    topo = line_topo()
+    # one early flow then silence: instances go idle and must expire
+    traffic = generate_traffic(cfg, service, topo, episode_steps=4, seed=0)
+
+    state = engine.init(jax.random.PRNGKey(0), topo)
+    placed_trace = []
+    for _ in range(100):  # 100 substeps = 100 ms
+        dec = jnp.where(state.flows.phase == PH_DECIDE, state.flows.node, -1)
+        state = engine.apply_substep(state, topo, traffic, dec)
+        placed_trace.append(bool(np.asarray(state.placed).any()))
+    # the t=0 flow placed SFs on decision...
+    assert any(placed_trace), "place-on-decision never installed an SF"
+    # ...which drained (~35 ms) and expired after 30 ms idle — well before
+    # the 100 ms mark the instances must be gone
+    assert not np.asarray(state.placed).any()
+    assert not np.asarray(state.sf_available).any()
+    # and the GC fired strictly after placement (not instantly)
+    assert placed_trace.index(True) < len(placed_trace) - 1
+
+
+def test_duration_mode_never_garbage_collects():
+    """DurationController keeps idle placed instances (the reference GC
+    runs only under FlowController)."""
+    service = make_service()
+    limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
+    cfg = SimConfig(ttl_choices=(1000.0,), vnf_timeout=30.0,
+                    inter_arrival_mean=1000.0)
+    engine = SimEngine(service, cfg, limits)
+    topo = line_topo()
+    traffic = generate_traffic(cfg, service, topo, episode_steps=4, seed=0)
+    nm = np.asarray(topo.node_mask)
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, nm] = 1.0 / nm.sum()
+    placement = jnp.asarray(np.broadcast_to(nm[:, None], (N, 3)).copy())
+    state = engine.init(jax.random.PRNGKey(0), topo)
+    for _ in range(4):
+        state, _ = engine.apply(state, topo, traffic, jnp.asarray(sched),
+                                placement)
+    assert np.asarray(state.placed)[nm].all()
+
+
+def test_truncated_arrivals_surface():
+    """Slot exhaustion delays arrivals and is visible: the counter rises
+    and check_invariants reports it (the reference has unbounded concurrent
+    flows, so any lateness is a divergence that must not be silent)."""
+    from gsc_tpu.utils.debug import check_invariants
+
+    service = make_service()
+    limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
+    # 2 flow slots, 1 ms arrivals, long-lived flows -> guaranteed exhaustion
+    cfg = SimConfig(ttl_choices=(1000.0,), max_flows=2,
+                    inter_arrival_mean=1.0)
+    engine = SimEngine(service, cfg, limits)
+    topo = line_topo()
+    traffic = generate_traffic(cfg, service, topo, episode_steps=1, seed=0)
+    nm = np.asarray(topo.node_mask)
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, nm] = 1.0 / nm.sum()
+    placement = jnp.asarray(np.broadcast_to(nm[:, None], (N, 3)).copy())
+    state = engine.init(jax.random.PRNGKey(0), topo)
+    state, _ = engine.apply(state, topo, traffic, jnp.asarray(sched),
+                            placement)
+    assert int(state.truncated_arrivals) > 0
+    errs = check_invariants(state, topo, engine.tables.chain_len)
+    assert any("admitted late" in e for e in errs)
+
+
+def test_cli_simulate_per_flow(tmp_path):
+    """cli simulate dispatches SimConfig.controller='per_flow'
+    (controller_class: FlowController in the YAML)."""
+    import json
+
+    import yaml
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+    from gsc_tpu.topology.synthetic import triangle, write_graphml
+
+    write_graphml(triangle(), str(tmp_path / "tri.graphml"))
+    with open(tmp_path / "svc.yaml", "w") as f:
+        yaml.safe_dump({
+            "sfc_list": {"sfc_1": ["a", "b", "c"]},
+            "sf_list": {n: {"processing_delay_mean": 5.0,
+                            "processing_delay_stdev": 0.0} for n in "abc"},
+        }, f)
+    with open(tmp_path / "sim.yaml", "w") as f:
+        yaml.safe_dump({
+            "inter_arrival_mean": 10.0, "deterministic_arrival": True,
+            "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+            "flow_size_shape": 0.001, "deterministic_size": True,
+            "run_duration": 100, "ttl_choices": [100],
+            "controller_class": "FlowController",
+        }, f)
+    r = CliRunner().invoke(cli, [
+        "simulate", "-d", "300", "-n", str(tmp_path / "tri.graphml"),
+        "--service", str(tmp_path / "svc.yaml"),
+        "-c", str(tmp_path / "sim.yaml"),
+        "--max-nodes", "8", "--max-edges", "8"])
+    assert r.exit_code == 0, r.output
+    out = json.loads(r.output.strip().splitlines()[-1])
+    assert out["total_flows"] > 0
+    assert out["successful_flows"] > 0
