@@ -89,30 +89,46 @@ class Trainer:
                                    global_step)
 
     def train(self, episodes: int, test_mode: bool = False,
-              verbose: bool = False, profile: bool = False) -> DDPGState:
-        """Train for ``episodes`` episodes (train-at-episode-end schedule,
-        simple_ddpg.py:280-329).  Returns the final learner state.  With
-        ``profile`` a jax profiler trace of the run is written to
-        <result_dir>/profile (SURVEY.md §5 tracing analogue)."""
+              verbose: bool = False, profile: bool = False,
+              init_state: Optional[DDPGState] = None,
+              init_buffer=None, start_episode: int = 0):
+        """Train through episode ``episodes - 1`` (train-at-episode-end
+        schedule, simple_ddpg.py:280-329).  Returns (final learner state,
+        replay buffer).  With ``profile`` a jax profiler trace of the run is
+        written to <result_dir>/profile (SURVEY.md §5 tracing analogue).
+
+        Exact resume: pass a restored (``init_state``, ``init_buffer``,
+        ``start_episode``) triple and the continuation reproduces an
+        uninterrupted run bit-for-bit — per-episode keys derive from
+        ``fold_in(seed, episode)`` rather than a sequential split chain, so
+        the host-side stream needs no replay (the device-side stream lives
+        in DDPGState.rng, which the checkpoint carries).  The reference
+        cannot do this: it never saves optimizer or replay state
+        (main.py:46-50, SURVEY.md §5)."""
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
-                return self.train(episodes, test_mode, verbose, profile=False)
-        rng = jax.random.PRNGKey(self.seed)
+                return self.train(episodes, test_mode, verbose,
+                                  profile=False, init_state=init_state,
+                                  init_buffer=init_buffer,
+                                  start_episode=start_episode)
+        base = jax.random.PRNGKey(self.seed)
         steps_per_ep = self.agent_cfg.episode_steps
 
-        topo, traffic = self.driver.episode(0, test_mode)
-        rng, k_env, k_agent = jax.random.split(rng, 3)
-        env_state, obs = self.env.reset(k_env, topo, traffic)
-        state = self.ddpg.init(k_agent, obs)
-        buffer = self.ddpg.init_buffer(obs)
+        topo, traffic = self.driver.episode(start_episode, test_mode)
+        env_state, obs = self.env.reset(
+            jax.random.fold_in(base, 1000 + start_episode), topo, traffic)
+        state = init_state if init_state is not None else \
+            self.ddpg.init(jax.random.fold_in(base, 0), obs)
+        buffer = init_buffer if init_buffer is not None else \
+            self.ddpg.init_buffer(obs)
 
         start = time.time()
-        for ep in range(episodes):
-            if ep > 0:
+        for ep in range(start_episode, episodes):
+            if ep > start_episode:
                 topo, traffic = self.driver.episode(ep, test_mode)
-                rng, k_env = jax.random.split(rng)
-                env_state, obs = self.env.reset(k_env, topo, traffic)
+                env_state, obs = self.env.reset(
+                    jax.random.fold_in(base, 1000 + ep), topo, traffic)
             global_step = ep * steps_per_ep
             state, buffer, env_state, obs, stats = self.ddpg.rollout_episode(
                 state, buffer, env_state, obs, topo, traffic,
@@ -121,7 +137,8 @@ class Trainer:
             end_step = global_step + steps_per_ep - 1
             if end_step >= self.agent_cfg.nb_steps_warmup_critic - 1:
                 state, learn_metrics = self.ddpg.learn_burst(state, buffer)
-            sps = (ep + 1) * steps_per_ep / (time.time() - start)
+            sps = ((ep - start_episode + 1) * steps_per_ep
+                   / (time.time() - start))
             self._log(ep, end_step, stats, learn_metrics, sps)
             if verbose:
                 print(f"episode={ep} return="
@@ -131,7 +148,7 @@ class Trainer:
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()
-        return state
+        return state, buffer
 
     def evaluate(self, state: DDPGState, episodes: int = 1,
                  test_mode: bool = True, telemetry: bool = False,

@@ -115,15 +115,21 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--runs", default=1, show_default=True,
               help="independent seeded runs; the best by mean reward over "
                    "the last 10 episodes is reported (select_best_agent)")
+@click.option("--resume", default=None,
+              help="checkpoint dir from a previous train run: restores "
+                   "params+opt+targets+replay+PRNG and continues exactly "
+                   "(total episode count still set by --episodes)")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
-          profile, runs, verbose):
+          profile, runs, resume, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics)."""
+    import numpy as _np
+
     from .agents.trainer import Trainer
-    from .utils.checkpoint import save_checkpoint
+    from .utils.checkpoint import load_checkpoint, save_checkpoint
     from .utils.experiment import (
         ExperimentResult,
         copy_inputs,
@@ -131,6 +137,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         setup_result_dir,
     )
 
+    if resume and runs != 1:
+        raise click.BadParameter("--resume only supports --runs 1")
     run_dirs = []
     outputs = {}
     for run in range(runs):
@@ -147,11 +155,28 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                                     scheduler, run_seed, max_nodes, max_edges)
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
                           tensorboard=tensorboard)
+        init_state = init_buffer = None
+        start_episode = 0
+        if resume:
+            topo0, traffic0 = driver.episode(0, False)
+            _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
+            restored = load_checkpoint(
+                resume, trainer.ddpg.init(jax.random.PRNGKey(0), obs0),
+                example_buffer=trainer.ddpg.init_buffer(obs0),
+                example_extra={"episode": _np.asarray(0, _np.int32)})
+            init_state = restored["state"]
+            init_buffer = restored["buffer"]
+            start_episode = int(restored["extra"]["episode"])
         result.runtime_start("train")
-        state = trainer.train(episodes, verbose=verbose, profile=profile)
+        state, buffer = trainer.train(episodes, verbose=verbose,
+                                      profile=profile, init_state=init_state,
+                                      init_buffer=init_buffer,
+                                      start_episode=start_episode)
         result.runtime_stop("train")
 
-        ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state)
+        ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
+                               buffer=buffer,
+                               extra={"episode": _np.asarray(episodes, _np.int32)})
         result.runtime_start("test")
         test = trainer.evaluate(state, episodes=1, test_mode=True,
                                 telemetry=True)
@@ -181,13 +206,21 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
     from .agents.trainer import Trainer
     from .utils.checkpoint import load_checkpoint
 
+    import numpy as _np
+
     env, driver, agent = _build(agent_config, simulator_config, service,
                                 scheduler, seed, max_nodes, max_edges)
     trainer = Trainer(env, driver, agent, seed=seed)
     topo, traffic = driver.episode(0, test_mode=True)
     _, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)
     example = trainer.ddpg.init(jax.random.PRNGKey(0), obs)
-    state = load_checkpoint(checkpoint, example)["state"]
+    try:  # full train checkpoint (state + replay + episode counter)
+        state = load_checkpoint(
+            checkpoint, example,
+            example_buffer=trainer.ddpg.init_buffer(obs),
+            example_extra={"episode": _np.asarray(0, _np.int32)})["state"]
+    except (ValueError, KeyError):  # state-only checkpoint
+        state = load_checkpoint(checkpoint, example)["state"]
     out = trainer.evaluate(state, episodes=episodes, test_mode=True)
     click.echo(json.dumps(out))
 

@@ -30,11 +30,19 @@ class ParallelDDPG:
     """B-replica data-parallel wrapper around the DDPG kernels."""
 
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
-                 num_replicas: int, gnn_impl: str = "dense"):
+                 num_replicas: int, gnn_impl: str = "dense",
+                 per_replica_topology: bool = False):
         self.env = env
         self.agent = agent
         self.B = num_replicas
         self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl)
+        # With per_replica_topology, ``topo`` arguments carry a leading [B]
+        # axis (build with topology.stack_topologies) and every replica
+        # trains on its own network — topology-generalization pressure in
+        # ONE scan, beyond the reference's serial per-episode swapping
+        # (gym_env.py:103-128).
+        self.per_replica_topology = per_replica_topology
+        self._t_ax = 0 if per_replica_topology else None
 
     # ----------------------------------------------------------------- init
     def init(self, rng, sample_obs) -> DDPGState:
@@ -56,7 +64,7 @@ class ParallelDDPG:
     def reset_all(self, rng, topo, traffic):
         """vmap env.reset across replicas (traffic batched [B, ...])."""
         keys = jax.random.split(rng, self.B)
-        return jax.vmap(self.env.reset, in_axes=(0, None, 0))(
+        return jax.vmap(self.env.reset, in_axes=(0, self._t_ax, 0))(
             keys, topo, traffic)
 
     # -------------------------------------------------------------- rollout
@@ -81,8 +89,6 @@ class ParallelDDPG:
         opens a fresh permutation frame, which is only correct at episode
         boundaries."""
         from ..env.permutation import ShuffleOps
-        mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
-                           self.env.limits.max_sfs)
         rng, sub = jax.random.split(state.rng)
         shuffle = ShuffleOps(self.agent, self.env.limits)
         # per-replica node permutations, fresh each step, via the same
@@ -91,13 +97,15 @@ class ParallelDDPG:
         perms0 = jax.vmap(shuffle.init_perm)(jax.random.split(k0, self.B))
         obs = jax.vmap(shuffle.permute_obs)(obs, perms0)
 
-        def one_step(es, ob, perm, buf, tr, key, i):
+        def one_step(es, ob, perm, buf, tr, tp, key, i):
+            mask = action_mask(tp.node_mask, self.env.limits.num_sfcs,
+                               self.env.limits.max_sfs)
             step_mask = shuffle.step_mask(ob, mask, perm)
             action = self.ddpg.choose_action(
                 state.actor_params, ob, step_mask, episode_start_step + i, key)
             action = self.env.process_action(action)
             es, next_ob, reward, done, info = self.env.step(
-                es, topo, tr, shuffle.env_action(action, perm))
+                es, tp, tr, shuffle.env_action(action, perm))
             next_ob, next_perm = shuffle.advance(
                 jax.random.fold_in(key, 1), next_ob, perm)
             buf = buffer_add(buf, {
@@ -111,8 +119,8 @@ class ParallelDDPG:
             env_states, obs, perms, buffers = carry
             keys = jax.random.split(jax.random.fold_in(sub, i), self.B)
             env_states, obs, perms, buffers, stats = jax.vmap(
-                one_step, in_axes=(0, 0, 0, 0, 0, 0, None))(
-                    env_states, obs, perms, buffers, traffic, keys, i)
+                one_step, in_axes=(0, 0, 0, 0, 0, self._t_ax, 0, None))(
+                    env_states, obs, perms, buffers, traffic, topo, keys, i)
             return (env_states, obs, perms, buffers), stats
 
         T = self.agent.episode_steps if num_steps is None else num_steps

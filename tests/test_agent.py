@@ -141,9 +141,126 @@ def test_trainer_smoke(tmp_path, graph_mode):
     driver.capacity = traffic.capacity
 
     trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path))
-    state = trainer.train(episodes=3)
+    state, _ = trainer.train(episodes=3)
     assert len(trainer.history) == 3
     rows = (tmp_path / "rewards.csv").read_text().strip().splitlines()
     assert rows[0] == "r" and len(rows) == 4
     result = trainer.evaluate(state, episodes=1)
     assert np.isfinite(result["mean_return"])
+
+
+def test_exact_resume_matches_straight_run(tmp_path):
+    """2 episodes + checkpoint + 2 resumed episodes == 4 straight episodes,
+    bit-for-bit (params, opt state, PRNG, replay) — the continue-training
+    capability the reference lacks (it saves only the actor module,
+    main.py:46-50)."""
+    from gsc_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    def build():
+        env, agent, topo, traffic = make_stack()
+        scheduler = SchedulerConfig(training_network_files=("x",),
+                                    inference_network="x", period=10)
+        driver = EpisodeDriver.__new__(EpisodeDriver)
+        driver.scheduler = scheduler
+        driver.sim_cfg = env.sim_cfg
+        driver.service = env.service
+        driver.episode_steps = agent.episode_steps
+        driver.base_seed = 0
+        driver.topologies = [topo]
+        driver.inference_topology = topo
+        driver.trace = None
+        driver.capacity = traffic.capacity
+        return Trainer(env, driver, agent, seed=3)
+
+    # straight 4-episode run
+    t_a = build()
+    state_a, buffer_a = t_a.train(episodes=4)
+
+    # 2 episodes, checkpoint round-trip, then 2 more
+    t_b = build()
+    state_mid, buffer_mid = t_b.train(episodes=2)
+    ckpt = save_checkpoint(str(tmp_path / "ck"), state_mid,
+                           buffer=buffer_mid,
+                           extra={"episode": np.asarray(2, np.int32)})
+    t_c = build()
+    restored = load_checkpoint(
+        ckpt, t_c.ddpg.init(jax.random.PRNGKey(0),
+                            _example_obs(t_c)),
+        example_buffer=t_c.ddpg.init_buffer(_example_obs(t_c)),
+        example_extra={"episode": np.asarray(0, np.int32)})
+    assert int(restored["extra"]["episode"]) == 2
+    state_b, buffer_b = t_c.train(
+        episodes=4, init_state=restored["state"],
+        init_buffer=restored["buffer"],
+        start_episode=int(restored["extra"]["episode"]))
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        (state_a.actor_params, state_a.critic_params, state_a.actor_opt,
+         state_a.rng, buffer_a.data),
+        (state_b.actor_params, state_b.critic_params, state_b.actor_opt,
+         state_b.rng, buffer_b.data))
+    # the resumed run's logged episodes continue the straight run's tail
+    tail_a = [r["episodic_return"] for r in t_a.history[2:]]
+    tail_b = [r["episodic_return"] for r in t_c.history]
+    np.testing.assert_allclose(tail_a, tail_b)
+
+
+def _example_obs(trainer):
+    topo, traffic = trainer.driver.episode(0, False)
+    _, obs = trainer.env.reset(jax.random.PRNGKey(0), topo, traffic)
+    return obs
+
+
+def test_cli_train_resume_roundtrip(tmp_path):
+    """cli train --resume continues a checkpointed run end-to-end, and cli
+    infer restores the resulting full checkpoint."""
+    import json
+
+    import yaml
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli as cli_group
+    from gsc_tpu.topology.synthetic import triangle, write_graphml
+
+    cfg = tmp_path
+    write_graphml(triangle(), str(cfg / "tri.graphml"))
+    yaml.safe_dump({
+        "sfc_list": {"sfc_1": ["a", "b", "c"]},
+        "sf_list": {n: {"processing_delay_mean": 5.0,
+                        "processing_delay_stdev": 0.0} for n in "abc"},
+    }, open(cfg / "svc.yaml", "w"))
+    yaml.safe_dump({
+        "inter_arrival_mean": 10.0, "deterministic_arrival": True,
+        "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+        "flow_size_shape": 0.001, "deterministic_size": True,
+        "run_duration": 100, "ttl_choices": [100], "max_flows": 32,
+    }, open(cfg / "sim.yaml", "w"))
+    yaml.safe_dump({
+        "graph_mode": True, "episode_steps": 3, "objective": "prio-flow",
+        "GNN_features": 4, "GNN_num_layers": 1, "GNN_num_iter": 1,
+        "actor_hidden_layer_nodes": [8], "critic_hidden_layer_nodes": [8],
+        "mem_limit": 32, "batch_size": 4, "nb_steps_warmup_critic": 3,
+    }, open(cfg / "agent.yaml", "w"))
+    yaml.safe_dump({
+        "training_network_files": [str(cfg / "tri.graphml")],
+        "inference_network": str(cfg / "tri.graphml"),
+    }, open(cfg / "sched.yaml", "w"))
+    args = [str(cfg / "agent.yaml"), str(cfg / "sim.yaml"),
+            str(cfg / "svc.yaml"), str(cfg / "sched.yaml"),
+            "--max-nodes", "8", "--max-edges", "8", "--quiet"]
+    r1 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "2",
+                                        "--result-dir", str(cfg / "res1")])
+    assert r1.exit_code == 0, (r1.output, r1.exception)
+    ckpt = json.loads(r1.output.strip().splitlines()[-1])["checkpoint"]
+    r2 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "4",
+                                        "--result-dir", str(cfg / "res2"),
+                                        "--resume", ckpt])
+    assert r2.exit_code == 0, (r2.output, r2.exception)
+    out2 = json.loads(r2.output.strip().splitlines()[-1])
+    r3 = CliRunner().invoke(cli_group, ["infer", *args[:4],
+                                        out2["checkpoint"],
+                                        "--max-nodes", "8",
+                                        "--max-edges", "8"])
+    assert r3.exit_code == 0, (r3.output, r3.exception)

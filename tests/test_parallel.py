@@ -109,3 +109,43 @@ def test_parallel_shuffle_nodes_smoke():
         state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
     assert int(buffers.size[0]) == 2
     assert np.isfinite(float(stats["episodic_return"]))
+
+
+def test_per_replica_topology_diversity():
+    """Two replicas train on DIFFERENT topologies inside one rollout scan
+    (stack_topologies + per_replica_topology=True) — beyond the reference's
+    serial per-episode topology swapping (gym_env.py:103-128)."""
+    import __graft_entry__ as ge
+    from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.topology import stack_topologies
+    from gsc_tpu.topology.compiler import compile_topology
+    from gsc_tpu.topology.synthetic import line, triangle
+
+    env, agent, _, _ = ge._flagship(max_nodes=8, max_edges=8,
+                                    episode_steps=3, max_flows=32)
+    t1 = compile_topology(triangle(), max_nodes=8, max_edges=8)
+    t2 = compile_topology(line(4), max_nodes=8, max_edges=8)
+    topos = stack_topologies([t1, t2])
+    traffic = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[generate_traffic(env.sim_cfg, env.service, t, 3, seed=0)
+          for t in (t1, t2)])
+    pddpg = ParallelDDPG(env, agent, num_replicas=2,
+                         per_replica_topology=True)
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topos, traffic)
+    # each replica observes its own network from the start
+    assert not np.array_equal(np.asarray(obs.node_mask[0]),
+                              np.asarray(obs.node_mask[1]))
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+    state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topos, traffic, jnp.int32(0))
+    assert int(buffers.size[0]) == 3 and int(buffers.size[1]) == 3
+    assert np.isfinite(float(stats["episodic_return"]))
+    # the stored transitions reflect two different networks
+    r0 = np.asarray(buffers.data["obs"].node_mask[0])
+    r1 = np.asarray(buffers.data["obs"].node_mask[1])
+    assert not np.array_equal(r0, r1)
+    state, metrics = pddpg.learn_burst(state, buffers)
+    assert np.isfinite(float(metrics["critic_loss"]))

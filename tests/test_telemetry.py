@@ -32,7 +32,7 @@ def make_trainer(tmp_path, **kw):
 
 def test_telemetry_csv_suite(tmp_path):
     trainer = make_trainer(tmp_path)
-    state = trainer.train(episodes=1)
+    state, _ = trainer.train(episodes=1)
     trainer.evaluate(state, episodes=1, telemetry=True, write_schedule=True)
     tdir = tmp_path / "test"
     expected = {"placements.csv", "node_metrics.csv", "metrics.csv",
@@ -61,7 +61,7 @@ def test_telemetry_csv_suite(tmp_path):
 
 def test_checkpoint_roundtrip(tmp_path):
     trainer = make_trainer(tmp_path)
-    state = trainer.train(episodes=1)
+    state, _ = trainer.train(episodes=1)
     path = save_checkpoint(str(tmp_path / "ckpt"), state,
                            extra={"episode": 1})
     restored = load_checkpoint(path, state, example_extra={"episode": 0})
