@@ -33,12 +33,21 @@ def cli():
 def init_configs(out: str):
     """Write an example config set (agent, simulator, service, scheduler,
     networks)."""
-    from .topology.synthetic import abilene, line, triangle, write_graphml
+    from .topology.synthetic import (
+        abilene,
+        bteurope,
+        line,
+        triangle,
+        write_graphml,
+    )
 
     os.makedirs(f"{out}/networks", exist_ok=True)
     write_graphml(abilene(), f"{out}/networks/abilene-in4.graphml")
     write_graphml(triangle(), f"{out}/networks/triangle.graphml")
     write_graphml(line(3), f"{out}/networks/line3.graphml")
+    # ladder rung 3: 24-node/37-edge real topology (BT Europe, Topology Zoo)
+    write_graphml(bteurope(node_cap_range=(1, 3)),
+                  f"{out}/networks/bteurope-in2-rand-cap1-2.graphml")
 
     with open(f"{out}/service_abc.yaml", "w") as f:
         yaml.safe_dump({
@@ -47,12 +56,59 @@ def init_configs(out: str):
                             "processing_delay_stdev": 0.0}
                         for n in "abc"},
         }, f)
+    # rung-3 5-SF chain with heterogeneous delays, a startup delay and a
+    # non-identity resource function (reader.py:60-72 pluggable demand)
+    with open(f"{out}/service_abcde.yaml", "w") as f:
+        yaml.safe_dump({
+            "sfc_list": {"sfc_1": ["a", "b", "c", "d", "e"]},
+            "sf_list": {
+                "a": {"processing_delay_mean": 5.0,
+                      "processing_delay_stdev": 0.0},
+                "b": {"processing_delay_mean": 2.0,
+                      "processing_delay_stdev": 0.0},
+                "c": {"processing_delay_mean": 10.0,
+                      "processing_delay_stdev": 0.0,
+                      "startup_delay": 5.0},
+                "d": {"processing_delay_mean": 1.0,
+                      "processing_delay_stdev": 0.0},
+                "e": {"processing_delay_mean": 4.0,
+                      "processing_delay_stdev": 0.0,
+                      "resource_function_id": "overhead"},
+            },
+        }, f)
     with open(f"{out}/simulator.yaml", "w") as f:
         yaml.safe_dump({
             "inter_arrival_mean": 10.0, "deterministic_arrival": True,
             "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
             "flow_size_shape": 0.001, "deterministic_size": True,
             "run_duration": 100, "ttl_choices": [100],
+        }, f)
+    # MMPP bursty-arrival scenario (rand-mmp-arrival12-8_det-size001_dur100)
+    with open(f"{out}/simulator_mmpp.yaml", "w") as f:
+        yaml.safe_dump({
+            "inter_arrival_mean": 12.0, "deterministic_arrival": False,
+            "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+            "flow_size_shape": 0.001, "deterministic_size": True,
+            "run_duration": 100, "ttl_choices": [100],
+            "use_states": True, "init_state": "state_1",
+            "states": {"state_1": {"inter_arr_mean": 12.0, "switch_p": 0.05},
+                       "state_2": {"inter_arr_mean": 8.0, "switch_p": 0.05}},
+        }, f)
+    # trace-driven scenario (configs/traces format: time,node,
+    # inter_arrival_mean[,cap] with popN node names, trace_processor.py:23-54)
+    with open(f"{out}/trace_rampup.csv", "w") as f:
+        f.write("time,node,inter_arrival_mean,cap\n")
+        f.write("0,pop0,10.0,\n")
+        f.write("500,pop0,5.0,\n")
+        f.write("1000,pop0,2.5,4\n")
+        f.write("1500,pop1,5.0,\n")
+    with open(f"{out}/simulator_trace.yaml", "w") as f:
+        yaml.safe_dump({
+            "inter_arrival_mean": 10.0, "deterministic_arrival": True,
+            "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+            "flow_size_shape": 0.001, "deterministic_size": True,
+            "run_duration": 100, "ttl_choices": [100],
+            "trace_path": f"{out}/trace_rampup.csv",
         }, f)
     with open(f"{out}/agent.yaml", "w") as f:
         yaml.safe_dump({

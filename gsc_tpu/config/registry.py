@@ -40,3 +40,15 @@ def get_resource_function(name: str) -> Callable:
 def _identity(load):
     """Default resource demand = load (reference: reader.py:86-87)."""
     return load
+
+
+@register_resource_function("overhead")
+def _overhead(load):
+    """Fixed base cost while instantiated + 20% per-unit overhead — the
+    shape of the reference's pluggable per-SF ``resource_function`` files
+    (reader.py:60-72 loads arbitrary load->demand callables).  jnp-traceable
+    and zero when the instance carries no load, so drained instances free
+    their base cost."""
+    import jax.numpy as jnp
+
+    return jnp.where(load > 0, 1.0 + 1.2 * load, 0.0)

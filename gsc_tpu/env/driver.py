@@ -20,6 +20,13 @@ from ..sim.traffic import TraceEvents, generate_traffic, traffic_capacity
 from ..topology.compiler import Topology, load_topology
 
 
+def _node_index(name: str) -> int:
+    """Trace 'node' column -> node index; accepts the reference's 'popN'
+    spelling (configs/traces/*.csv) and bare integers."""
+    s = str(name)
+    return int(s[3:]) if s.startswith("pop") else int(s)
+
+
 class EpisodeDriver:
     """Yields (topology, traffic) per episode following the scheduler config."""
 
@@ -49,7 +56,7 @@ class EpisodeDriver:
                 max_edges=max_edges, force_link_cap=sim_cfg.force_link_cap,
                 force_node_cap=sim_cfg.force_node_cap, seed=base_seed)
         self.inference_topology = inference_topology
-        self.trace = (TraceEvents.from_csv(sim_cfg.trace_path, int)
+        self.trace = (TraceEvents.from_csv(sim_cfg.trace_path, _node_index)
                       if sim_cfg.trace_path else None)
         # fixed traffic capacity across episodes -> no recompiles
         max_ing = max(int(np.asarray(t.is_ingress).sum()) for t in

@@ -56,6 +56,77 @@ def abilene(num_ingress: int = 4, link_cap: float = 1000.0,
                        coords=[(c[1], c[2]) for c in _ABILENE_CITIES])
 
 
+# (label, lat, long) — public Internet Topology Zoo "BT Europe" node list
+# (the reference's 24-node/37-edge ladder-rung-3 scenario,
+# configs/networks/BtEurope-in2-cap1.graphml; its 24/37 scale is exactly
+# the reference's padding limits, environment_limits.py:44-64).  New York
+# and Washington are satellite/transatlantic PoPs without coordinates in
+# Topology Zoo; their links use the reader's 3 ms default (reader.py:212).
+_BTEUROPE_CITIES = [
+    ("Budapest", 47.49801, 19.03991),
+    ("Munich", 48.13743, 11.57549),
+    ("Prague", 50.08804, 14.42076),
+    ("Vienna", 48.20849, 16.37208),
+    ("Dusseldorf", 51.22172, 6.77616),
+    ("Frankfurt", 50.11667, 8.68333),
+    ("Zurich", 47.36667, 8.55),
+    ("Paris", 48.85341, 2.3488),
+    ("Milan", 45.46427, 9.18951),
+    ("Barcelona", 41.38879, 2.15899),
+    ("Goonhilly", 50.05, -5.2),
+    ("New York", None, None),
+    ("Washington", None, None),
+    ("Madrid", 40.4165, -3.70256),
+    ("Helsinki", 60.16952, 24.93545),
+    ("Copenhagen", 55.67594, 12.56553),
+    ("London1", 51.50853, -0.12574),
+    ("London2", 51.50853, -0.12574),
+    ("Madley", 52.03333, -2.85),
+    ("Dublin", 53.34399, -6.26719),
+    ("Brussels", 50.85045, 4.34878),
+    ("Amsterdam", 52.37403, 4.88969),
+    ("Gothenburg", 57.70716, 11.96679),
+    ("Stockholm", 59.33258, 18.0649),
+]
+_BTEUROPE_EDGES = [
+    (0, 17), (0, 5), (1, 4), (1, 5), (2, 16), (2, 5), (3, 5), (3, 21),
+    (4, 5), (4, 21), (5, 6), (5, 8), (5, 17), (5, 21), (6, 17), (7, 17),
+    (7, 21), (8, 17), (9, 13), (9, 21), (10, 17), (11, 17), (12, 16),
+    (13, 17), (14, 23), (15, 23), (16, 17), (16, 21), (16, 23), (17, 18),
+    (17, 19), (17, 20), (17, 21), (19, 21), (21, 22), (21, 23), (22, 23),
+]
+
+
+def bteurope(num_ingress: int = 2, link_cap: float = 1000.0,
+             node_cap: float = 1.0,
+             node_cap_range: Optional[Tuple[int, int]] = None,
+             seed: int = 0) -> NetworkSpec:
+    """BT Europe (Topology Zoo): 24 nodes / 37 edges, first ``num_ingress``
+    nodes ingress — the BtEurope-in2-cap1 rung-3 scenario shape.  With
+    ``node_cap_range`` caps are random integers in [lo, hi) like the
+    rand-cap variants."""
+    rng = np.random.default_rng(seed)
+    n = len(_BTEUROPE_CITIES)
+    if node_cap_range is not None:
+        caps = [float(rng.integers(*node_cap_range)) for _ in range(n)]
+    else:
+        caps = [float(node_cap)] * n
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = []
+    for u, v in _BTEUROPE_EDGES:
+        _, lat1, lon1 = _BTEUROPE_CITIES[u]
+        _, lat2, lon2 = _BTEUROPE_CITIES[v]
+        if None in (lat1, lon1, lat2, lon2):
+            delay = 3.0  # reader.py:212 default when geo data is missing
+        else:
+            delay = geo_delay_ms(lat1, lon1, lat2, lon2)
+        edges.append((u, v, link_cap, delay))
+    return NetworkSpec(
+        node_caps=caps, node_types=types, edges=edges,
+        node_names=[c[0] for c in _BTEUROPE_CITIES],
+        coords=[(c[1] or 0.0, c[2] or 0.0) for c in _BTEUROPE_CITIES])
+
+
 def triangle(node_caps: Sequence[float] = (10.0, 10.0, 10.0),
              link_cap: float = 100.0, link_delay: float = 1.0,
              num_ingress: int = 1) -> NetworkSpec:
