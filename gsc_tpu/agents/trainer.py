@@ -9,6 +9,7 @@ result_writer.py:6-38, optional TensorBoard like simple_ddpg.py:165-174).
 from __future__ import annotations
 
 import csv
+import logging
 import os
 import time
 from typing import Dict, List, Optional
@@ -20,6 +21,8 @@ from ..config.schema import AgentConfig
 from ..env.driver import EpisodeDriver
 from ..env.env import ServiceCoordEnv
 from .ddpg import DDPG, DDPGState
+
+log = logging.getLogger("gsc_tpu.agents.trainer")
 
 
 class RewardsWriter:
@@ -141,10 +144,13 @@ class Trainer:
                    / (time.time() - start))
             self._log(ep, end_step, stats, learn_metrics, sps)
             if verbose:
-                print(f"episode={ep} return="
-                      f"{float(np.asarray(stats['episodic_return'])):.3f} "
-                      f"succ={float(np.asarray(stats['mean_succ_ratio'])):.3f} "
-                      f"sps={sps:.1f}")
+                # per-episode progress line (the reference's tqdm + SPS
+                # TensorBoard log, simple_ddpg.py:269-271) via the package
+                # logger — setup_logging routes it to console + run.log
+                log.info(
+                    "episode=%d return=%.3f succ=%.3f sps=%.1f", ep,
+                    float(np.asarray(stats["episodic_return"])),
+                    float(np.asarray(stats["mean_succ_ratio"])), sps)
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()

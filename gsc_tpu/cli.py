@@ -207,6 +207,9 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                              "simulator_config": simulator_config,
                              "service": service, "scheduler": scheduler,
                              "seed": run_seed}
+        # console + per-run file log (setup_logging, main.py:307-329)
+        from .utils.logging import setup_logging
+        setup_logging(verbose=False, logfile=os.path.join(rdir, "run.log"))
         env, driver, agent = _build(agent_config, simulator_config, service,
                                     scheduler, run_seed, max_nodes, max_edges)
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,

@@ -1,0 +1,52 @@
+"""Logging setup — console + per-run file handler.
+
+The reference configures logging from ``logging.conf`` (console handler,
+per-module levels) and ``setup_logging`` attaches a per-run file handler
+under the result directory (src/rlsp/agents/main.py:307-329,
+logging.conf:1-34).  Here the same policy is code, not an INI file: one
+console handler on the root ``gsc_tpu`` logger (INFO, DEBUG with
+``verbose``), quieter defaults for the chatty simulator modules, and an
+optional per-run ``run.log`` file handler in the experiment's result dir.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+# per-module default levels (logging.conf's flowsimulator/oldsimulator
+# sections keep the simulator quiet unless asked)
+_MODULE_LEVELS = {
+    "gsc_tpu.sim": logging.WARNING,
+    "gsc_tpu.env": logging.WARNING,
+}
+
+
+def setup_logging(verbose: bool = False,
+                  logfile: Optional[str] = None) -> logging.Logger:
+    """Configure the ``gsc_tpu`` logger tree; returns the root package
+    logger.  Idempotent: repeated calls reconfigure rather than stack
+    handlers."""
+    logger = logging.getLogger("gsc_tpu")
+    logger.setLevel(logging.DEBUG)
+    logger.propagate = False
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+
+    console = logging.StreamHandler()
+    console.setLevel(logging.DEBUG if verbose else logging.INFO)
+    console.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(console)
+
+    for name, level in _MODULE_LEVELS.items():
+        logging.getLogger(name).setLevel(
+            logging.DEBUG if verbose else level)
+
+    if logfile:
+        os.makedirs(os.path.dirname(os.path.realpath(logfile)), exist_ok=True)
+        fh = logging.FileHandler(logfile, mode="a")
+        fh.setFormatter(logging.Formatter(_FORMAT))
+        fh.setLevel(logging.DEBUG if verbose else logging.INFO)
+        logger.addHandler(fh)
+    return logger

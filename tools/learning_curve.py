@@ -1,0 +1,94 @@
+"""Full-scale learning-curve run (BASELINE.md protocol: reproduce the
+reference's quality metrics on the flagship scenario, then measure
+throughput).
+
+Trains ParallelDDPG on Abilene rand-cap1-2 (the reference benchmark
+workload) for ``--episodes`` full 200-step episodes across ``--replicas``
+vmapped envs and prints per-episode mean return / success ratio plus the
+first-10 vs last-10 summary.  Episodes run CHUNKED (see bench.py) so the
+TPU never sees a 200-step single-call scan.
+
+On the single shared TPU run it via::
+
+    python tools/learning_curve.py --replicas 64 --episodes 40
+
+(CPU works too, smaller: --replicas 4 --episode-steps 50.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=64)
+    ap.add_argument("--episodes", type=int, default=40)
+    ap.add_argument("--episode-steps", type=int, default=200)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim.traffic import generate_traffic
+
+    T, B, chunk = args.episode_steps, args.replicas, args.chunk
+    assert T % chunk == 0
+    env, agent, topo, _ = _flagship(episode_steps=T)
+    traffic0 = [generate_traffic(env.sim_cfg, env.service, topo, T, seed=s)
+                for s in range(B)]
+    traffic = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *traffic0)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(args.seed), topo,
+                                      traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(args.seed + 1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+
+    returns, succ = [], []
+    t0 = time.time()
+    for ep in range(args.episodes):
+        # fresh per-episode traffic like the trainer (host resample)
+        traffic0 = [generate_traffic(env.sim_cfg, env.service, topo, T,
+                                     seed=1000 * ep + s) for s in range(B)]
+        traffic = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                         *traffic0)
+        env_states, obs = pddpg.reset_all(
+            jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), ep),
+            topo, traffic)
+        for c in range(T // chunk):
+            start = jnp.int32(ep * T + c * chunk)
+            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+                state, buffers, env_states, obs, topo, traffic, start, chunk)
+        state, metrics = pddpg.learn_burst(state, buffers)
+        r = float(stats["episodic_return"])
+        s = float(stats["mean_succ_ratio"])
+        returns.append(r)
+        succ.append(s)
+        print(f"episode={ep} return={r:.3f} succ={s:.3f} "
+              f"critic_loss={float(metrics['critic_loss']):.4f} "
+              f"elapsed={time.time() - t0:.0f}s", file=sys.stderr)
+    k = min(10, max(1, len(returns) // 4))
+    print(json.dumps({
+        "replicas": B, "episodes": args.episodes, "episode_steps": T,
+        "first_k_return": round(sum(returns[:k]) / k, 3),
+        "last_k_return": round(sum(returns[-k:]) / k, 3),
+        "first_k_succ": round(sum(succ[:k]) / k, 4),
+        "last_k_succ": round(sum(succ[-k:]) / k, 4),
+        "wall_s": round(time.time() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
