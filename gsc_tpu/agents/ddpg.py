@@ -57,10 +57,11 @@ class DDPG:
     """Factory closing over static config; all methods are pure and jitted."""
 
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
-                 gnn_impl: str = "dense"):
+                 gnn_impl: str = None):
         self.env = env
         self.agent = agent
         self.action_dim = env.limits.action_dim
+        gnn_impl = gnn_impl or agent.gnn_impl  # config-selected embedder
         self.actor = Actor(agent=agent, action_dim=self.action_dim,
                            gnn_impl=gnn_impl)
         self.critic = QNetwork(agent=agent, gnn_impl=gnn_impl)

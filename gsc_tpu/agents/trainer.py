@@ -52,7 +52,7 @@ class Trainer:
     def __init__(self, env: ServiceCoordEnv, driver: EpisodeDriver,
                  agent_cfg: AgentConfig, seed: int = 0,
                  result_dir: Optional[str] = None,
-                 tensorboard: bool = False, gnn_impl: str = "dense"):
+                 tensorboard: bool = False, gnn_impl: str = None):
         self.env = env
         self.driver = driver
         self.agent_cfg = agent_cfg

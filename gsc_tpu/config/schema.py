@@ -213,6 +213,11 @@ class AgentConfig:
     gnn_num_layers: int = 2
     gnn_num_iter: int = 2
     gnn_aggr: str = "mean"
+    # GNN embedder implementation: "dense" (XLA-fused masked dense
+    # attention) or "pallas" (fused TPU kernel, gsc_tpu/ops/pallas_gat.py;
+    # interpret-mode on CPU).  New key — the reference's torch-geometric
+    # GATv2 has no such switch.
+    gnn_impl: str = "dense"
     actor_hidden_layer_nodes: Tuple[int, ...] = (256,)
     critic_hidden_layer_nodes: Tuple[int, ...] = (64,)
 
@@ -250,6 +255,8 @@ class AgentConfig:
                 f"unsupported agent_type {self.agent_type!r} (only DDPG)")
         if self.gnn_num_layers < 1 or self.gnn_num_iter < 1:
             raise ValueError("gnn_num_layers and gnn_num_iter must be >= 1")
+        if self.gnn_impl not in ("dense", "pallas"):
+            raise ValueError(f"unknown gnn_impl {self.gnn_impl!r}")
         if self.objective not in SUPPORTED_OBJECTIVES:
             raise ValueError(
                 f"Unexpected objective {self.objective}. Must be in {SUPPORTED_OBJECTIVES}."

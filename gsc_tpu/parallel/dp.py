@@ -30,11 +30,15 @@ class ParallelDDPG:
     """B-replica data-parallel wrapper around the DDPG kernels."""
 
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
-                 num_replicas: int, gnn_impl: str = "dense",
-                 per_replica_topology: bool = False):
+                 num_replicas: int, gnn_impl: str = None,
+                 per_replica_topology: bool = False,
+                 sample_mode: str = "across"):
+        if sample_mode not in ("across", "local"):
+            raise ValueError(f"unknown sample_mode {sample_mode!r}")
         self.env = env
         self.agent = agent
         self.B = num_replicas
+        self.sample_mode = sample_mode
         self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl)
         # With per_replica_topology, ``topo`` arguments carry a leading [B]
         # axis (build with topology.stack_topologies) and every replica
@@ -138,17 +142,39 @@ class ParallelDDPG:
 
     # ------------------------------------------------------------- learning
     def _sample_across(self, buffers: ReplayBuffer, key):
-        """Uniform batch over (replica, slot) pairs from all shards."""
+        """Uniform batch over (replica, slot) pairs from all shards —
+        exact single-agent semantics, but the gather touches every shard:
+        on a real dp mesh each inner-loop batch is cross-device traffic."""
         kb, ks = jax.random.split(key)
         bidx = jax.random.randint(kb, (self.agent.batch_size,), 0, self.B)
         sidx = jax.random.randint(ks, (self.agent.batch_size,), 0,
                                   jnp.maximum(buffers.size[bidx], 1))
         return jax.tree_util.tree_map(lambda d: d[bidx, sidx], buffers.data)
 
+    def _sample_local(self, buffers: ReplayBuffer, key):
+        """Shard-local stratified batch: batch_size/B (>=1) transitions from
+        each replica's OWN shard, concatenated along the sharded axis — no
+        cross-device gather; the batch-mean gradient reduces across shards
+        through the psum XLA inserts from the sharding annotations.  Same
+        uniform (replica, slot) marginal as _sample_across with the replica
+        counts stratified; effective batch size rounds to B*max(batch//B,1)."""
+        b_per = max(self.agent.batch_size // self.B, 1)
+        keys = jax.random.split(key, self.B)
+
+        def pick(shard, size, k):
+            idx = jax.random.randint(k, (b_per,), 0, jnp.maximum(size, 1))
+            return jax.tree_util.tree_map(lambda d: d[idx], shard)
+
+        batch = jax.vmap(pick)(buffers.data, buffers.size, keys)
+        return jax.tree_util.tree_map(
+            lambda d: d.reshape((self.B * b_per,) + d.shape[2:]), batch)
+
     @partial(jax.jit, static_argnums=0)
     def learn_burst(self, state: DDPGState, buffers: ReplayBuffer
                     ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
-        """episode_steps gradient steps sampling across all replica shards
-        (simple_ddpg.py:307-325 schedule)."""
+        """episode_steps gradient steps over the replica shards
+        (simple_ddpg.py:307-325 schedule), sampling per ``sample_mode``."""
+        sampler = (self._sample_local if self.sample_mode == "local"
+                   else self._sample_across)
         return self.ddpg._learn_burst(
-            state, lambda k: self._sample_across(buffers, k))
+            state, lambda k: sampler(buffers, k))
