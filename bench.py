@@ -156,7 +156,28 @@ def orchestrate():
 
 
 # --------------------------------------------------------------------- worker
-def worker(replicas: int, chunk: int, episodes: int):
+def _rung4_stack(episode_steps):
+    """BASELINE ladder rung 4 entry: 64-node random gen_networks-style
+    topology, 512 flow slots (BASELINE.md:32)."""
+    from __graft_entry__ import _abc_service
+    from gsc_tpu.config.schema import AgentConfig, EnvLimits, SimConfig
+    from gsc_tpu.env.env import ServiceCoordEnv
+    from gsc_tpu.topology.compiler import compile_topology
+    from gsc_tpu.topology.synthetic import random_network
+
+    service = _abc_service()
+    limits = EnvLimits(max_nodes=64, max_edges=128, num_sfcs=1, max_sfs=3)
+    agent = AgentConfig(graph_mode=True, episode_steps=episode_steps,
+                        objective="prio-flow")
+    sim_cfg = SimConfig(ttl_choices=(100.0,), max_flows=512)
+    env = ServiceCoordEnv(service, sim_cfg, agent, limits)
+    topo = compile_topology(random_network(64, seed=7), max_nodes=64,
+                            max_edges=128)
+    return env, agent, topo
+
+
+def worker(replicas: int, chunk: int, episodes: int,
+           scenario: str = "flagship"):
     import jax
     import jax.numpy as jnp
 
@@ -167,7 +188,10 @@ def worker(replicas: int, chunk: int, episodes: int):
     assert EPISODE_STEPS % chunk == 0, (EPISODE_STEPS, chunk)
     chunks_per_ep = EPISODE_STEPS // chunk
     t_start = time.time()
-    env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
+    if scenario == "rung4":
+        env, agent, topo = _rung4_stack(EPISODE_STEPS)
+    else:
+        env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
     B = replicas
     traffic = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs),
@@ -208,13 +232,14 @@ def worker(replicas: int, chunk: int, episodes: int):
         "metric": "env_steps_per_sec_per_chip",
         "value": round(sps, 1),
         "unit": "env-steps/s",
-        "replicas": B, "chunk": chunk,
+        "replicas": B, "chunk": chunk, "scenario": scenario,
         "measure_wall_s": round(dt, 1),
     }))
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+        worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+               sys.argv[5] if len(sys.argv) > 5 else "flagship")
     else:
         orchestrate()

@@ -97,3 +97,30 @@ def test_trace_changes_traffic(assets):
     # the cap raise at t=1000 lands in the node_cap tensor
     nc = np.asarray(tr.node_cap)
     assert nc[12, 0] == 4.0 and nc[5, 0] != 4.0
+
+
+def test_rung4_random_network_trains():
+    """Rung-4 entry (BASELINE.md config 4): a 64-node randomized topology
+    trains through the parallel rollout + learn path at reduced replicas."""
+    import jax.numpy as jnp
+
+    from bench import _rung4_stack
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim.traffic import generate_traffic
+
+    env, agent, topo = _rung4_stack(episode_steps=2)
+    B = 2
+    traffic = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[generate_traffic(env.sim_cfg, env.service, topo, 2, seed=s)
+          for s in range(B)])
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, sample_mode="local")
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+    state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
+    state, metrics = pddpg.learn_burst(state, buffers)
+    assert np.isfinite(float(stats["episodic_return"]))
+    assert np.isfinite(float(metrics["critic_loss"]))
