@@ -157,22 +157,15 @@ def orchestrate():
 
 # --------------------------------------------------------------------- worker
 def _rung4_stack(episode_steps):
-    """BASELINE ladder rung 4 entry: 64-node random gen_networks-style
-    topology, 512 flow slots (BASELINE.md:32)."""
-    from __graft_entry__ import _abc_service
-    from gsc_tpu.config.schema import AgentConfig, EnvLimits, SimConfig
-    from gsc_tpu.env.env import ServiceCoordEnv
-    from gsc_tpu.topology.compiler import compile_topology
+    """BASELINE ladder rung 4 entry: a 64-node random gen_networks-style
+    topology (fixed seed for comparable runs), 512 flow slots
+    (BASELINE.md:32) — same service/agent/sim config as the flagship."""
+    from __graft_entry__ import _flagship
     from gsc_tpu.topology.synthetic import random_network
 
-    service = _abc_service()
-    limits = EnvLimits(max_nodes=64, max_edges=128, num_sfcs=1, max_sfs=3)
-    agent = AgentConfig(graph_mode=True, episode_steps=episode_steps,
-                        objective="prio-flow")
-    sim_cfg = SimConfig(ttl_choices=(100.0,), max_flows=512)
-    env = ServiceCoordEnv(service, sim_cfg, agent, limits)
-    topo = compile_topology(random_network(64, seed=7), max_nodes=64,
-                            max_edges=128)
+    env, agent, topo, _ = _flagship(
+        max_nodes=64, max_edges=128, episode_steps=episode_steps,
+        max_flows=512, spec=random_network(64, seed=7))
     return env, agent, topo
 
 
@@ -185,6 +178,9 @@ def worker(replicas: int, chunk: int, episodes: int,
     from gsc_tpu.parallel import ParallelDDPG
     from gsc_tpu.sim.traffic import generate_traffic
 
+    if scenario not in ("flagship", "rung4"):
+        raise SystemExit(f"unknown scenario {scenario!r} "
+                         "(expected 'flagship' or 'rung4')")
     assert EPISODE_STEPS % chunk == 0, (EPISODE_STEPS, chunk)
     chunks_per_ep = EPISODE_STEPS // chunk
     t_start = time.time()
