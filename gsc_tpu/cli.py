@@ -340,7 +340,7 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges):
         sched = np.zeros(limits.scheduling_shape, np.float32)
         sched[:, :, :, nm] = 1.0 / n_real
         placement = jnp.asarray(np.broadcast_to(nm[:, None],
-                                                (max_nodes, limits.max_sfs)))
+                                                (max_nodes, limits.sf_pool)))
         for _ in range(steps):
             state, metrics = engine.apply(state, topo, traffic,
                                           jnp.asarray(sched), placement)

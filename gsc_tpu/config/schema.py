@@ -294,7 +294,18 @@ class EnvLimits:
     max_nodes: int = 24
     max_edges: int = 37
     num_sfcs: int = 1
+    # max chain length — sizes the schedule tensor's SF-POSITION axis
     max_sfs: int = 3
+    # distinct SFs in the catalog — sizes all per-(node, SF-id) state
+    # (placement, load, proc tables).  None = max_sfs (single-chain configs,
+    # where position and id coincide).  A mixed catalog (e.g. abc + de)
+    # needs the two axes separated: chain positions stay <= max_sfs while
+    # SF ids run over the whole pool.
+    num_sfs: Optional[int] = None
+
+    @property
+    def sf_pool(self) -> int:
+        return self.num_sfs if self.num_sfs is not None else self.max_sfs
 
     @property
     def scheduling_shape(self) -> Tuple[int, int, int, int]:
@@ -312,7 +323,8 @@ class EnvLimits:
     def for_service(cls, service: ServiceConfig, max_nodes: int = 24,
                     max_edges: int = 37) -> "EnvLimits":
         return cls(max_nodes=max_nodes, max_edges=max_edges,
-                   num_sfcs=service.num_sfcs, max_sfs=service.max_chain_len)
+                   num_sfcs=service.num_sfcs, max_sfs=service.max_chain_len,
+                   num_sfs=len(service.sf_list))
 
 
 def replace(cfg, **kw):

@@ -125,12 +125,12 @@ class ServiceCoordEnv:
                           jnp.clip(state.sim.run_idx, 0, t_steps - 1)])
         placement = derive_placement(
             schedule, self.tables.chain_sf, self.tables.chain_len,
-            active_ing, self.limits.max_sfs)
+            active_ing, self.limits.sf_pool)
         sim, metrics = self.engine.apply(state.sim, topo, traffic, schedule,
                                          placement)
         reward, ewma, info = compute_reward(
             self.agent, metrics, placement, topo.node_mask,
-            self.limits.max_sfs, self.min_delay, self.diameter,
+            self.limits.sf_pool, self.min_delay, self.diameter,
             state.ewma_flows)
         step = state.step + 1
         done = step >= self.agent.episode_steps

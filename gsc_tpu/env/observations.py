@@ -61,7 +61,9 @@ def node_features(metrics, topo: Topology, node_cap_now: jnp.ndarray,
             else:
                 ing = jnp.zeros_like(node_cap_now)
                 for c in range(chain_sf.shape[0]):
-                    ing = ing + metrics.run_requested[:, c, int(chain_sf[c, 0])]
+                    # run_requested is position-indexed; chain entry point
+                    # is position 0
+                    ing = ing + metrics.run_requested[:, c, 0]
             cols.append(_maxnorm(ing))
         elif comp == "node_load":
             usage = metrics.run_processed_traffic.sum(axis=-1)

@@ -43,10 +43,9 @@ class DummyEngine(SimEngine):
         # are non-trivial (the reference's canned state carries fixed
         # traffic/load dicts, dummy_simulator.py:51-155)
         ing = (topo.is_ingress & topo.node_mask).astype(jnp.float32)
-        first_sf = jnp.asarray(self.tables.chain_sf)[:, 0]
         req = jnp.zeros_like(m.run_requested)
         for c in range(req.shape[1]):
-            req = req.at[:, c, first_sf[c]].set(ing)
+            req = req.at[:, c, 0].set(ing)  # position-indexed entry point
         proc_traffic = placement.astype(jnp.float32) * 0.5
         m = m.replace(
             generated=m.generated + gen, processed=m.processed + proc,

@@ -86,18 +86,23 @@ _ARRIVALS_PER_SUBSTEP = 8
 class ServiceTables:
     """Static per-service tensors derived from ServiceConfig."""
 
-    chain_sf: np.ndarray      # [C, S] i32 SF index per chain position (-1 pad)
+    chain_sf: np.ndarray      # [C, S_pos] i32 SF id per chain position (-1 pad)
     chain_len: np.ndarray     # [C] i32
-    proc_mean: np.ndarray     # [S] f32
-    proc_std: np.ndarray      # [S] f32
-    startup_delay: np.ndarray  # [S] f32
-    resource_fns: Tuple[Callable, ...]  # per SF index
+    proc_mean: np.ndarray     # [P] f32, P = size of the SF catalog
+    proc_std: np.ndarray      # [P] f32
+    startup_delay: np.ndarray  # [P] f32
+    resource_fns: Tuple[Callable, ...]  # per SF id
 
     @classmethod
     def build(cls, service: ServiceConfig, limits: EnvLimits) -> "ServiceTables":
         sf_names = list(service.sf_names)
         s = limits.max_sfs
         c = limits.num_sfcs
+        pool = limits.sf_pool
+        if len(sf_names) > pool:
+            raise ValueError(
+                f"SF catalog has {len(sf_names)} SFs but limits.sf_pool is "
+                f"{pool}; set EnvLimits.num_sfs (EnvLimits.for_service does)")
         chain_sf = np.full((c, s), -1, np.int32)
         chain_len = np.zeros(c, np.int32)
         for ci, name in enumerate(service.sfc_names):
@@ -105,17 +110,17 @@ class ServiceTables:
             chain_len[ci] = len(chain)
             for si, sf in enumerate(chain):
                 chain_sf[ci, si] = sf_names.index(sf)
-        proc_mean = np.zeros(s, np.float32)
-        proc_std = np.zeros(s, np.float32)
-        startup = np.zeros(s, np.float32)
+        proc_mean = np.zeros(pool, np.float32)
+        proc_std = np.zeros(pool, np.float32)
+        startup = np.zeros(pool, np.float32)
         fns = []
-        for i, name in enumerate(sf_names[:s]):
+        for i, name in enumerate(sf_names[:pool]):
             sf = service.sf_list[name]
             proc_mean[i] = sf.processing_delay_mean
             proc_std[i] = sf.processing_delay_stdev
             startup[i] = sf.startup_delay
             fns.append(get_resource_function(sf.resource_function_id))
-        while len(fns) < s:
+        while len(fns) < pool:
             fns.append(get_resource_function("default"))
         return cls(chain_sf=chain_sf, chain_len=chain_len, proc_mean=proc_mean,
                    proc_std=proc_std, startup_delay=startup,
@@ -192,7 +197,8 @@ class SimEngine:
         self.H = cfg.release_horizon
         self.N = limits.max_nodes
         self.C = limits.num_sfcs
-        self.S = limits.max_sfs
+        self.S = limits.max_sfs     # chain-position axis (schedule tensor)
+        self.P = limits.sf_pool     # SF-id axis (placement/load/proc tables)
         self.E = limits.max_edges
         max_hold = (self.H - 1) * self.dt
         if cfg.run_duration > max_hold:
@@ -201,7 +207,8 @@ class SimEngine:
     # ------------------------------------------------------------------ init
     def init(self, rng, topo: Topology) -> SimState:
         del topo  # shapes are static; topology enters at apply()
-        return init_state(rng, self.M, self.N, self.C, self.S, self.E, self.H)
+        return init_state(rng, self.M, self.N, self.C, self.S, self.E,
+                          self.H, p=self.P)
 
     # ------------------------------------------------------- demanded capacity
     def _demanded(self, load_plus: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
@@ -414,28 +421,30 @@ class SimEngine:
             # schedule lookup (add_requesting_flow,
             # default_decision_maker.py:35-36)
             m = m.replace(run_requested=m.run_requested.at[
-                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
+                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_pos
             ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
 
             # WRR over the schedule row with realized-ratio counters
             # (default_decision_maker.py:42-66); same-cell same-substep
             # collisions run in slot-order rounds so later flows see updated
             # counters
-            cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_now
+            cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_pos
             rank = _rank_in_cell(cell, wrr, self.N * self.C * self.S)
             flow_counts = m.run_flow_counts
             R = self.cfg.wrr_rank_levels
             for r in range(R):
                 sel = wrr & ((rank == r) if r < R - 1 else (rank >= r))
-                counts = flow_counts[node, jnp.clip(sfc, 0), sf_now]  # [M,N]
+                counts = flow_counts[node, jnp.clip(sfc, 0), sf_pos]  # [M,N]
                 total = counts.sum(-1, keepdims=True)
                 ratios = jnp.where(total > 0, counts / jnp.maximum(total, 1), 0.0)
-                probs = state.schedule[node, jnp.clip(sfc, 0), sf_now]
+                # schedule tensor is indexed by chain POSITION (its SF axis
+                # mirrors the action layout, environment_limits.py:44-51)
+                probs = state.schedule[node, jnp.clip(sfc, 0), sf_pos]
                 diffs = jnp.where(probs > 0, probs - ratios, -1.0)
                 choice = jnp.argmax(diffs, axis=-1).astype(jnp.int32)
                 dest = jnp.where(sel, choice, dest)
                 flow_counts = flow_counts.at[
-                    jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_now,
+                    jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_pos,
                     choice
                 ].add(jnp.where(sel, 1, 0), mode="drop")
             m = m.replace(run_flow_counts=flow_counts)
@@ -447,11 +456,11 @@ class SimEngine:
             wrr = wrr & has_dec
             dest = jnp.where(wrr, jnp.clip(ext_decisions, 0, self.N - 1), dest)
             m = m.replace(run_requested=m.run_requested.at[
-                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_now
+                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_pos
             ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
             # place-on-decision (flow_controller.py:46-60): install the SF at
             # the decided node if absent, stamping its startup time
-            newly_placed = jnp.zeros((self.N, self.S), bool).at[
+            newly_placed = jnp.zeros((self.N, self.P), bool).at[
                 jnp.where(wrr, dest, self.N), sf_now].max(wrr, mode="drop")
             newly_placed = newly_placed & ~placed
             placed = placed | newly_placed
@@ -543,7 +552,7 @@ class SimEngine:
         demanded = jnp.zeros(self.M, jnp.float32)
         for _ in range(self.cfg.admission_iters):
             cols = []
-            for s in range(self.S):
+            for s in range(self.P):
                 v = jnp.where(admitted_n & (sf_now == s), dr, 0.0)[node_order]
                 cs = jnp.cumsum(v)
                 pref_sorted = cs - (cs[starts_node] - v[starts_node])

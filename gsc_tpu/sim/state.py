@@ -98,15 +98,21 @@ class SimMetrics:
     run_e2e_sum: jnp.ndarray        # [] f32
     run_e2e_max: jnp.ndarray        # [] f32
     run_path_delay_sum: jnp.ndarray  # [] f32
-    run_requested: jnp.ndarray      # [N,C,S] f32 ('run_total_requested_traffic')
+    run_requested: jnp.ndarray      # [N,C,S_pos] f32 ('run_total_requested_traffic';
+                                    #     indexed by chain POSITION, which maps 1:1
+                                    #     to the reference's per-SF-name keying
+                                    #     within a chain)
     run_requested_node: jnp.ndarray  # [N] f32 (ingress-generated dr per node)
-    run_processed_traffic: jnp.ndarray  # [N,S] f32 (per node per SF)
+    run_processed_traffic: jnp.ndarray  # [N,P] f32 (per node per SF id)
     run_flow_counts: jnp.ndarray    # [N,C,S,N] i32 (WRR state, metrics.py:92-95)
     run_max_node_usage: jnp.ndarray  # [N] f32
     run_passed_traffic: jnp.ndarray  # [E] f32 (per-edge, simulatorparams.py:249-257)
 
     @classmethod
-    def zeros(cls, n: int, c: int, s: int, e: int) -> "SimMetrics":
+    def zeros(cls, n: int, c: int, s: int, e: int,
+              p: int = None) -> "SimMetrics":
+        if p is None:
+            p = s  # single-chain configs: position axis == id axis
         i = lambda *shape: jnp.zeros(shape, jnp.int32)
         f = lambda *shape: jnp.zeros(shape, jnp.float32)
         return cls(
@@ -116,7 +122,7 @@ class SimMetrics:
             run_generated=i(), run_processed=i(), run_dropped=i(),
             run_dropped_per_node=i(n), run_e2e_sum=f(), run_e2e_max=f(),
             run_path_delay_sum=f(), run_requested=f(n, c, s),
-            run_requested_node=f(n), run_processed_traffic=f(n, s),
+            run_requested_node=f(n), run_processed_traffic=f(n, p),
             run_flow_counts=i(n, c, s, n), run_max_node_usage=f(n),
             run_passed_traffic=f(e),
         )
@@ -127,7 +133,8 @@ class SimMetrics:
         z = SimMetrics.zeros(self.run_dropped_per_node.shape[0],
                              self.run_requested.shape[1],
                              self.run_requested.shape[2],
-                             self.run_passed_traffic.shape[0])
+                             self.run_passed_traffic.shape[0],
+                             p=self.run_processed_traffic.shape[1])
         return self.replace(
             run_generated=z.run_generated, run_processed=z.run_processed,
             run_dropped=z.run_dropped,
@@ -200,7 +207,7 @@ class SimState:
     sf_startup: jnp.ndarray   # [N,S] f32 startup_time of the instance
     sf_last_active: jnp.ndarray  # [N,S] f32 last time the instance had load
                                  #     ('last_active', flow_controller.py:94-112)
-    placed: jnp.ndarray       # [N,S] bool current placement action
+    placed: jnp.ndarray       # [N,P] bool current placement action (SF-id axis)
     schedule: jnp.ndarray     # [N,C,S,N] f32 current scheduling weights
     edge_used: jnp.ndarray    # [E] f32 in-flight dr per undirected edge
     # capacity release ring buffers, indexed by substep mod horizon
@@ -217,22 +224,24 @@ class SimState:
 
 
 def init_state(rng, max_flows: int, n: int, c: int, s: int, e: int,
-               horizon: int) -> SimState:
+               horizon: int, p: int = None) -> SimState:
+    if p is None:
+        p = s
     return SimState(
         t=jnp.zeros((), jnp.float32),
         run_idx=jnp.zeros((), jnp.int32),
         flows=FlowTable.empty(max_flows),
         cursor=jnp.zeros((), jnp.int32),
-        node_load=jnp.zeros((n, s), jnp.float32),
-        sf_available=jnp.zeros((n, s), bool),
-        sf_startup=jnp.zeros((n, s), jnp.float32),
-        sf_last_active=jnp.zeros((n, s), jnp.float32),
-        placed=jnp.zeros((n, s), bool),
+        node_load=jnp.zeros((n, p), jnp.float32),
+        sf_available=jnp.zeros((n, p), bool),
+        sf_startup=jnp.zeros((n, p), jnp.float32),
+        sf_last_active=jnp.zeros((n, p), jnp.float32),
+        placed=jnp.zeros((n, p), bool),
         schedule=jnp.zeros((n, c, s, n), jnp.float32),
         edge_used=jnp.zeros(e, jnp.float32),
-        rel_node=jnp.zeros((horizon, n, s), jnp.float32),
+        rel_node=jnp.zeros((horizon, n, p), jnp.float32),
         rel_edge=jnp.zeros((horizon, e), jnp.float32),
-        metrics=SimMetrics.zeros(n, c, s, e),
+        metrics=SimMetrics.zeros(n, c, s, e, p=p),
         rng=rng,
         truncated_arrivals=jnp.zeros((), jnp.int32),
     )

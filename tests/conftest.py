@@ -18,8 +18,25 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 # exact float32 matmuls so implementation-parity tests compare numerics,
 # not matmul precision modes
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Release compiled executables after each test module.
+
+    The full suite compiles ~100 XLA programs in one process; letting them
+    accumulate has segfaulted XLA's CPU compiler near the end of the run
+    (in whichever module happened to compile around position ~90 — seen in
+    two different modules).  Per-module cache clearing caps the live
+    executable count; modules recompile their own programs anyway."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
