@@ -212,7 +212,7 @@ class SimEngine:
 
     # ------------------------------------------------------- demanded capacity
     def _demanded(self, load_plus: jnp.ndarray, avail: jnp.ndarray) -> jnp.ndarray:
-        """Total demanded node capacity given per-SF loads [..., S] summed over
+        """Total demanded node capacity given per-SF loads [..., P] summed over
         available SFs through per-SF resource functions
         (base_processor.py:24-35)."""
         cols = []
@@ -545,8 +545,8 @@ class SimEngine:
         node_order = _group_order(node)
         node_sorted = node[node_order]
         starts_node = _run_starts(node_sorted)
-        base_load_mine = node_load[node]                       # [M,S]
-        avail_mine = sf_available[node]                        # [M,S]
+        base_load_mine = node_load[node]                       # [M,P]
+        avail_mine = sf_available[node]                        # [M,P]
         cap_mine = cap_now[node]
         admitted_n = want
         demanded = jnp.zeros(self.M, jnp.float32)
@@ -558,7 +558,7 @@ class SimEngine:
                 pref_sorted = cs - (cs[starts_node] - v[starts_node])
                 cols.append(jnp.zeros(self.M, dr.dtype)
                             .at[node_order].set(pref_sorted))
-            load_mine = base_load_mine + jnp.stack(cols, axis=-1)  # [M,S]
+            load_mine = base_load_mine + jnp.stack(cols, axis=-1)  # [M,P]
             demanded = self._demanded(load_mine, avail_mine)
             admitted_n = want & (demanded <= cap_mine + _EPS)
         drop_nodecap = want & ~admitted_n

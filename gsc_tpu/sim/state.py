@@ -104,7 +104,7 @@ class SimMetrics:
                                     #     within a chain)
     run_requested_node: jnp.ndarray  # [N] f32 (ingress-generated dr per node)
     run_processed_traffic: jnp.ndarray  # [N,P] f32 (per node per SF id)
-    run_flow_counts: jnp.ndarray    # [N,C,S,N] i32 (WRR state, metrics.py:92-95)
+    run_flow_counts: jnp.ndarray    # [N,C,S_pos,N] i32 (WRR state, metrics.py:92-95)
     run_max_node_usage: jnp.ndarray  # [N] f32
     run_passed_traffic: jnp.ndarray  # [E] f32 (per-edge, simulatorparams.py:249-257)
 
@@ -202,16 +202,16 @@ class SimState:
     cursor: jnp.ndarray       # [] i32 next unconsumed traffic-schedule record
     # per (node, SF) bookkeeping (reference 'available_sf' dicts,
     # simulatorparams.py:66-73, duration_controller.py:46-60)
-    node_load: jnp.ndarray    # [N,S] f32 current processed load
-    sf_available: jnp.ndarray  # [N,S] bool placed or still draining
-    sf_startup: jnp.ndarray   # [N,S] f32 startup_time of the instance
-    sf_last_active: jnp.ndarray  # [N,S] f32 last time the instance had load
+    node_load: jnp.ndarray    # [N,P] f32 current processed load (SF-id axis)
+    sf_available: jnp.ndarray  # [N,P] bool placed or still draining
+    sf_startup: jnp.ndarray   # [N,P] f32 startup_time of the instance
+    sf_last_active: jnp.ndarray  # [N,P] f32 last time the instance had load
                                  #     ('last_active', flow_controller.py:94-112)
     placed: jnp.ndarray       # [N,P] bool current placement action (SF-id axis)
     schedule: jnp.ndarray     # [N,C,S,N] f32 current scheduling weights
     edge_used: jnp.ndarray    # [E] f32 in-flight dr per undirected edge
     # capacity release ring buffers, indexed by substep mod horizon
-    rel_node: jnp.ndarray     # [H,N,S] f32
+    rel_node: jnp.ndarray     # [H,N,P] f32
     rel_edge: jnp.ndarray     # [H,E] f32
     metrics: SimMetrics
     rng: jnp.ndarray          # PRNG key
