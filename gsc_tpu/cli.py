@@ -36,6 +36,8 @@ def init_configs(out: str):
     from .topology.synthetic import (
         abilene,
         bteurope,
+        claranet,
+        compuserve,
         line,
         triangle,
         write_graphml,
@@ -48,6 +50,10 @@ def init_configs(out: str):
     # ladder rung 3: 24-node/37-edge real topology (BT Europe, Topology Zoo)
     write_graphml(bteurope(node_cap_range=(1, 3)),
                   f"{out}/networks/bteurope-in2-rand-cap1-2.graphml")
+    # the reference's other small real scenarios (Topology Zoo shapes)
+    write_graphml(claranet(), f"{out}/networks/claranet-in4-cap1.graphml")
+    write_graphml(compuserve(),
+                  f"{out}/networks/compuserve-in4-cap1.graphml")
 
     with open(f"{out}/service_abc.yaml", "w") as f:
         yaml.safe_dump({

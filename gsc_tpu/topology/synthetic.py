@@ -127,6 +127,45 @@ def bteurope(num_ingress: int = 2, link_cap: float = 1000.0,
         coords=[(c[1] or 0.0, c[2] or 0.0) for c in _BTEUROPE_CITIES])
 
 
+# Internet Topology Zoo graph structures for the reference's two other
+# small real scenarios (Claranet-in4, Compuserve-in4).  The reference's
+# assets carry no coordinates, so every link uses the reader's 3 ms
+# default delay (reader.py:212).
+_CLARANET_EDGES = [  # 15 nodes / 18 edges
+    (0, 3), (1, 3), (1, 4), (2, 3), (3, 14), (4, 12), (5, 14), (6, 14),
+    (7, 8), (7, 10), (7, 14), (9, 10), (9, 11), (10, 11), (10, 12),
+    (10, 14), (12, 13), (12, 14),
+]
+_COMPUSERVE_EDGES = [  # 14 nodes / 17 edges
+    (0, 12), (1, 12), (2, 11), (2, 12), (2, 5), (3, 12), (4, 5), (4, 13),
+    (6, 13), (6, 7), (7, 8), (7, 12), (8, 9), (9, 10), (9, 12), (10, 11),
+    (12, 13),
+]
+
+
+def _zoo_network(n: int, edge_list, num_ingress: int, link_cap: float,
+                 node_cap: float, link_delay: float = 3.0) -> NetworkSpec:
+    caps = [float(node_cap)] * n
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = [(u, v, link_cap, link_delay) for u, v in edge_list]
+    return NetworkSpec(node_caps=caps, node_types=types, edges=edges)
+
+
+def claranet(num_ingress: int = 4, link_cap: float = 1000.0,
+             node_cap: float = 1.0) -> NetworkSpec:
+    """Claranet (Topology Zoo): 15 nodes / 18 edges — the reference's
+    Claranet-in4-cap1 scenario shape."""
+    return _zoo_network(15, _CLARANET_EDGES, num_ingress, link_cap, node_cap)
+
+
+def compuserve(num_ingress: int = 4, link_cap: float = 1000.0,
+               node_cap: float = 1.0) -> NetworkSpec:
+    """Compuserve (Topology Zoo): 14 nodes / 17 edges — the reference's
+    Compuserve-in4-cap1 scenario shape."""
+    return _zoo_network(14, _COMPUSERVE_EDGES, num_ingress, link_cap,
+                        node_cap)
+
+
 def triangle(node_caps: Sequence[float] = (10.0, 10.0, 10.0),
              link_cap: float = 100.0, link_delay: float = 1.0,
              num_ingress: int = 1) -> NetworkSpec:

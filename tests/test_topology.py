@@ -117,3 +117,19 @@ def test_config_loading(tmp_path):
 
     with pytest.raises(ValueError):
         load_agent(str(tmp_path / "agent.yaml"), objective="nope")
+
+
+def test_zoo_network_shapes():
+    """Claranet/Compuserve (Topology Zoo) match the reference's scenario
+    shapes (Claranet-in4-cap1: 15n/18e, Compuserve-in4-cap1: 14n/17e) and
+    round-trip through GraphML."""
+    import numpy as np
+
+    for spec_fn, n, e in ((synthetic.claranet, 15, 18),
+                          (synthetic.compuserve, 14, 17)):
+        topo = compile_topology(spec_fn(), max_nodes=24, max_edges=37)
+        assert int(np.asarray(topo.node_mask).sum()) == n
+        assert int(np.asarray(topo.edge_mask).sum()) == e
+        assert int(np.asarray(topo.is_ingress).sum()) == 4
+        pd = np.asarray(topo.path_delay)[:n, :n]
+        assert np.isfinite(pd).all()
