@@ -1,0 +1,85 @@
+"""One-shot TPU validation runbook — run this the moment the axon tunnel
+answers (``python tools/tpu_validate.py``).
+
+Stages (each in a bounded-time subprocess so a fault can't wedge the
+parent; results accumulate in TPU_VALIDATION.json):
+
+1. probe     — backend init in a child with a timeout
+2. pallas    — compiled (non-interpret) Pallas GAT kernel vs the dense
+               XLA embedder on the flagship shapes (the interpret-mode
+               parity test runs in CI; this validates the real kernel)
+3. bench     — the flagship bench ladder (delegates to bench.py)
+4. learning  — a short full-scale learning-curve run (tools/learning_curve.py)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PALLAS_CHECK = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp, numpy as np
+import __graft_entry__ as ge
+from gsc_tpu.models.nets import Actor
+env, agent, topo, traffic = ge._flagship()
+_, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+import dataclasses
+outs = {{}}
+for impl in ("dense", "pallas"):
+    a = Actor(agent=dataclasses.replace(agent, gnn_impl=impl),
+              action_dim=env.limits.action_dim, gnn_impl=impl)
+    params = a.init(jax.random.PRNGKey(1), obs)
+    outs[impl] = np.asarray(jax.jit(a.apply)(params, obs))
+# same init -> same params tree; kernels must agree numerically
+diff = float(np.max(np.abs(outs["dense"] - outs["pallas"])))
+rel = diff / (float(np.max(np.abs(outs["dense"]))) + 1e-9)
+print("PALLAS_PARITY", diff, rel)
+assert rel < 5e-2, (diff, rel)
+"""
+
+
+def run_stage(name, cmd, timeout, results):
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True)
+        ok = r.returncode == 0
+        out = (r.stdout or "")[-1500:]
+        err = (r.stderr or "")[-1500:]
+    except subprocess.TimeoutExpired:
+        ok, out, err = False, "", f"timeout after {timeout}s"
+    results[name] = {"ok": ok, "wall_s": round(time.time() - t0, 1),
+                     "stdout_tail": out, "stderr_tail": err}
+    print(f"[{name}] {'OK' if ok else 'FAIL'} "
+          f"({results[name]['wall_s']}s)", file=sys.stderr)
+    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return ok
+
+
+def main():
+    results = {}
+    py = sys.executable
+    if not run_stage("probe", [py, "-c",
+                               "import jax; print(jax.devices())"],
+                     240, results):
+        print("TPU backend unreachable — nothing to validate",
+              file=sys.stderr)
+        sys.exit(1)
+    run_stage("pallas", [py, "-c", _PALLAS_CHECK.format(repo=REPO)],
+              600, results)
+    run_stage("bench", [py, os.path.join(REPO, "bench.py")], 3600, results)
+    run_stage("learning",
+              [py, os.path.join(REPO, "tools", "learning_curve.py"),
+               "--replicas", "64", "--episodes", "12"], 3000, results)
+    print(json.dumps(results["bench"], indent=1))
+
+
+if __name__ == "__main__":
+    main()
