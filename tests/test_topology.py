@@ -133,3 +133,29 @@ def test_zoo_network_shapes():
         assert int(np.asarray(topo.is_ingress).sum()) == 4
         pd = np.asarray(topo.path_delay)[:n, :n]
         assert np.isfinite(pd).all()
+
+
+def test_large_zoo_network_shapes():
+    """Tinet/Chinanet/Interoute (Topology Zoo) match the reference's
+    larger scenario shapes (tinet: 53n/89e, chinanet: 42n/66e,
+    interroute: 110n/146 deduped simple edges) with first-N ingress,
+    integer caps in {0,1,2}, connected path matrices, and geodesic link
+    delays where both endpoints carry coordinates."""
+    cases = ((synthetic.tinet, 53, 89, 2, 64, 128),
+             (synthetic.chinanet, 42, 66, 2, 64, 128),
+             (synthetic.interroute, 110, 146, 4, 128, 192))
+    for spec_fn, n, e, ing, max_n, max_e in cases:
+        spec = spec_fn()
+        assert len(spec.node_caps) == n and len(spec.edges) == e
+        assert all(c in (0.0, 1.0, 2.0) for c in spec.node_caps)
+        topo = compile_topology(spec, max_nodes=max_n, max_edges=max_e)
+        assert int(np.asarray(topo.node_mask).sum()) == n
+        assert int(np.asarray(topo.edge_mask).sum()) == e
+        assert int(np.asarray(topo.is_ingress).sum()) == ing
+        pd = np.asarray(topo.path_delay)[:n, :n]
+        assert np.isfinite(pd).all()  # connected
+        # geodesic delays: some real spread, none absurd (< 150 ms); short
+        # links legitimately round to 0 ms (reader.py:223-225 int rounding)
+        delays = [d for (_, _, _, d) in spec.edges]
+        assert min(delays) >= 0 and max(delays) < 150.0
+        assert len({round(d, 3) for d in delays}) >= 4
