@@ -159,20 +159,6 @@ def _rank_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
     return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
 
 
-def _prefix_sum_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
-                        vals: jnp.ndarray, num_cells: int) -> jnp.ndarray:
-    """Inclusive per-cell prefix sum of masked vals, in slot order; every
-    slot (masked or not) reads its cell's prefix at its own position."""
-    del num_cells
-    m = cell_id.shape[0]
-    order = _group_order(cell_id)
-    v = jnp.where(mask, vals, 0.0)[order]
-    cs = jnp.cumsum(v)
-    starts = _run_starts(cell_id[order])
-    prefix_sorted = cs - (cs[starts] - v[starts])
-    return jnp.zeros(m, vals.dtype).at[order].set(prefix_sorted)
-
-
 class SimEngine:
     """Factory-built engine closing over static config.
 
@@ -362,20 +348,32 @@ class SimEngine:
             jnp.where(free, free_rank, self.M)].set(slots, mode="drop")
         tgt = slot_of_rank[jnp.clip(arr_rank, 0, self.M - 1)]
 
-        def scatter_arr(arr, vals, fill=None):
-            return arr.at[jnp.where(spawn, tgt, self.M)].set(vals, mode="drop")
-
-        phase = scatter_arr(phase, PH_DECIDE)
-        node = scatter_arr(node, traffic.arr_ingress[cand_c])
-        position = scatter_arr(position, 0)
-        sfc = scatter_arr(F.sfc, traffic.arr_sfc[cand_c])
-        dr = scatter_arr(F.dr, traffic.arr_dr[cand_c])
-        duration = scatter_arr(F.duration, traffic.arr_duration[cand_c])
-        ttl = scatter_arr(ttl, traffic.arr_ttl[cand_c])
-        egress = scatter_arr(F.egress, traffic.arr_egress[cand_c])
-        e2e = scatter_arr(e2e, 0.0)
-        dest = scatter_arr(F.dest, -1)
-        pend_path = scatter_arr(F.pend_path, 0.0)
+        # one packed scatter per dtype instead of 11 per-field scatters —
+        # scatters end XLA fusions, so per-substep op count (the TPU cost
+        # driver) tracks the number of scatters, not the bytes moved
+        arr_idx = jnp.where(spawn, tgt, self.M)
+        a_i32 = jnp.zeros_like(cand)
+        int_cur = jnp.stack([phase, node, position, F.sfc, F.egress, F.dest],
+                            axis=-1)                           # [M, 6]
+        int_new = jnp.stack([a_i32 + PH_DECIDE, traffic.arr_ingress[cand_c],
+                             a_i32, traffic.arr_sfc[cand_c],
+                             traffic.arr_egress[cand_c], a_i32 - 1],
+                            axis=-1)                           # [A, 6]
+        int_cur = int_cur.at[arr_idx].set(int_new, mode="drop")
+        phase, node, position, sfc, egress, dest = (
+            int_cur[:, 0], int_cur[:, 1], int_cur[:, 2], int_cur[:, 3],
+            int_cur[:, 4], int_cur[:, 5])
+        a_f32 = jnp.zeros(cand.shape, jnp.float32)
+        flt_cur = jnp.stack([F.dr, F.duration, ttl, e2e, F.pend_path],
+                            axis=-1)                           # [M, 5]
+        flt_new = jnp.stack([traffic.arr_dr[cand_c],
+                             traffic.arr_duration[cand_c],
+                             traffic.arr_ttl[cand_c], a_f32, a_f32],
+                            axis=-1)                           # [A, 5]
+        flt_cur = flt_cur.at[arr_idx].set(flt_new, mode="drop")
+        dr, duration, ttl, e2e, pend_path = (
+            flt_cur[:, 0], flt_cur[:, 1], flt_cur[:, 2], flt_cur[:, 3],
+            flt_cur[:, 4])
         hop_next = F.hop_next
         n_spawn = spawn.sum()
         cursor = state.cursor + n_spawn
@@ -490,12 +488,21 @@ class SimEngine:
         eid = topo.adj_edge_id[node, nh]
         eid_c = jnp.clip(eid, 0)
         # greedy slot-order link admission via iterative refinement
-        # (deduct_link_resources, default_forwarder.py:95-111)
-        admitted = hop_req & (eid >= 0)
+        # (deduct_link_resources, default_forwarder.py:95-111).  The edge
+        # grouping is fixed across iterations (only ``admitted`` changes),
+        # so sort once and redo only the masked cumsum per iteration.
+        order_e = _group_order(eid_c)
+        starts_e = _run_starts(eid_c[order_e])
+        req_s = (hop_req & (eid >= 0))[order_e]
+        dr_s = dr[order_e]
+        headroom_s = (topo.edge_cap[eid_c] - edge_used[eid_c] + _EPS)[order_e]
+        adm_s = req_s
         for _ in range(self.cfg.admission_iters):
-            prefix = _prefix_sum_in_cell(eid_c, admitted, dr, self.E)
-            admitted = hop_req & (eid >= 0) & (
-                edge_used[eid_c] + prefix <= topo.edge_cap[eid_c] + _EPS)
+            v = jnp.where(adm_s, dr_s, 0.0)
+            cs = jnp.cumsum(v)
+            prefix_sorted = cs - (cs[starts_e] - v[starts_e])
+            adm_s = req_s & (prefix_sorted <= headroom_s)
+        admitted = jnp.zeros(self.M, bool).at[order_e].set(adm_s)
         drop_link = hop_req & ~admitted
         add_e = jnp.where(admitted, dr, 0.0)
         edge_used = edge_used.at[jnp.where(admitted, eid_c, self.E)].add(
@@ -540,27 +547,28 @@ class SimEngine:
         # (request_resources, base_processor.py:51-101).  Every candidate
         # sees the base load plus the same-substep admitted drs of flows
         # m'<=m at its node, per SF column: one (node, slot) grouping reused
-        # across refinement iters, with S [M]-cumsums per iter — no
-        # [M, N*S] materialization.
+        # across refinement iters, with a single [M,P] cumsum per iter — no
+        # [M, N*S] materialization, no per-SF Python loop.
         node_order = _group_order(node)
         node_sorted = node[node_order]
         starts_node = _run_starts(node_sorted)
-        base_load_mine = node_load[node]                       # [M,P]
-        avail_mine = sf_available[node]                        # [M,P]
-        cap_mine = cap_now[node]
-        admitted_n = want
-        demanded = jnp.zeros(self.M, jnp.float32)
+        base_load_s = node_load[node_sorted]                   # [M,P]
+        avail_s = sf_available[node_sorted]                    # [M,P]
+        cap_s = cap_now[node_sorted]
+        want_s = want[node_order]
+        dr_col_s = dr[node_order][:, None]
+        sf_onehot_s = (sf_now[node_order][:, None]
+                       == jnp.arange(self.P)[None, :])         # [M,P]
+        adm_ns = want_s
+        dem_s = jnp.zeros(self.M, jnp.float32)
         for _ in range(self.cfg.admission_iters):
-            cols = []
-            for s in range(self.P):
-                v = jnp.where(admitted_n & (sf_now == s), dr, 0.0)[node_order]
-                cs = jnp.cumsum(v)
-                pref_sorted = cs - (cs[starts_node] - v[starts_node])
-                cols.append(jnp.zeros(self.M, dr.dtype)
-                            .at[node_order].set(pref_sorted))
-            load_mine = base_load_mine + jnp.stack(cols, axis=-1)  # [M,P]
-            demanded = self._demanded(load_mine, avail_mine)
-            admitted_n = want & (demanded <= cap_mine + _EPS)
+            v = jnp.where(adm_ns[:, None] & sf_onehot_s, dr_col_s, 0.0)
+            cs = jnp.cumsum(v, axis=0)
+            pref_sorted = cs - (cs[starts_node] - v[starts_node])
+            dem_s = self._demanded(base_load_s + pref_sorted, avail_s)
+            adm_ns = want_s & (dem_s <= cap_s + _EPS)
+        admitted_n = jnp.zeros(self.M, bool).at[node_order].set(adm_ns)
+        demanded = jnp.zeros(self.M, jnp.float32).at[node_order].set(dem_s)
         drop_nodecap = want & ~admitted_n
         add_n = jnp.where(admitted_n, dr, 0.0)
         node_load = node_load.at[
@@ -619,13 +627,15 @@ class SimEngine:
             (drop_ttl_sw, DROP_NODE_CAP),
         ]
         any_drop = jnp.zeros(self.M, bool)
-        reasons = m.drop_reasons
+        n_reasons = m.drop_reasons.shape[0]
+        adds = [jnp.zeros((), m.drop_reasons.dtype)] * n_reasons
         for mask, reason in drops:
             any_drop = any_drop | mask
             # ttl<=0 always recorded as TTL (metrics.py:158-160)
             is_ttl = mask & (ttl <= _EPS)
-            reasons = reasons.at[DROP_TTL].add(is_ttl.sum())
-            reasons = reasons.at[reason].add((mask & ~is_ttl).sum())
+            adds[DROP_TTL] = adds[DROP_TTL] + is_ttl.sum()
+            adds[reason] = adds[reason] + (mask & ~is_ttl).sum()
+        reasons = m.drop_reasons + jnp.stack(adds)
         n_drop = any_drop.sum()
         m = m.replace(
             drop_reasons=reasons,
