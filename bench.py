@@ -41,15 +41,16 @@ EPISODES_MEASURED = 2
 PROBE_TIMEOUT = 240          # backend init is normally ~10 s; wedged = hang
 PROBE_RETRIES = 3
 PROBE_RETRY_SLEEP = 60
-# (replicas, chunk_steps, worker_timeout_s).  The substep scan is
-# per-fusion overhead-bound (measured ~65 us/fusion on the axon chip at
-# B=64, flat in B), so throughput scales ~linearly with replicas;
-# escalation only after a banked rung.  Chunked 50-step calls are the
-# validated operating range (200-step single scans fault the runtime).
+# (replicas, chunk_steps, worker_timeout_s).  With the one-hot engine
+# (gathers/scatters as MXU contractions) the measured substep wall is
+# ~0.9 ms at B=64 and ~3.5 ms at B=512, so 50-step chunk calls stay well
+# under the tunnel's per-call deadline (faults appeared near ~60-120 s
+# calls); throughput peaks near B=512 (~1.5k env-steps/s calibration).
+# Escalation only after a banked rung.
 LADDER = [
-    (64, 50, 1200),
-    (256, 50, 1800),
-    (1024, 50, 1800),
+    (64, 50, 900),
+    (256, 50, 1200),
+    (512, 50, 1500),
 ]
 # total wall budget: never start a rung that could overshoot this with a
 # number already banked (the driver's artifact must land)

@@ -127,13 +127,56 @@ class ServiceTables:
                    resource_fns=tuple(fns))
 
 
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _onehot(idx: jnp.ndarray, n: int, dtype=jnp.float32) -> jnp.ndarray:
+    """[M] i32 -> [M, n] one-hot rows; out-of-range indices give all-zero
+    rows (the ``mode="drop"`` analogue).
+
+    TPU rationale: vmapped gathers/scatters lower to per-index serial
+    updates (~2 ns/element, linear in B*M — measured to dominate the
+    substep at B>=256), while one-hot contractions run on the MXU/VPU.
+    With ``Precision.HIGHEST`` a one-hot dot is EXACT: each output is a
+    single 1.0*x product (bf16x3 splits a f32 mantissa exactly; all other
+    terms are 0), so gather/scatter semantics are reproduced bit-for-bit
+    up to f32 summation order in the scatter-add cases."""
+    return (idx[:, None] == jnp.arange(n, dtype=idx.dtype)[None, :]
+            ).astype(dtype)
+
+
+def _take(table: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """rows ``table[idx]`` via a precomputed one-hot [M, n] @ [n, ...]."""
+    t = table.astype(jnp.float32)
+    flat = t.reshape(t.shape[0], -1)
+    out = jnp.dot(oh, flat, precision=_HI).reshape((oh.shape[0],) + t.shape[1:])
+    if table.dtype == jnp.bool_:
+        return out > 0.5
+    if jnp.issubdtype(table.dtype, jnp.integer):
+        return jnp.round(out).astype(table.dtype)
+    return out
+
+
+def _pick(rows: jnp.ndarray, oh_col: jnp.ndarray) -> jnp.ndarray:
+    """rows[m, idx[m]] for per-row column indices as a masked VPU reduce:
+    [M, n] rows x [M, n] one-hot -> [M]."""
+    out = (rows.astype(jnp.float32) * oh_col).sum(-1)
+    if rows.dtype == jnp.bool_:
+        return out > 0.5
+    if jnp.issubdtype(rows.dtype, jnp.integer):
+        return jnp.round(out).astype(rows.dtype)
+    return out
+
+
 def _group_order(cell_id: jnp.ndarray) -> jnp.ndarray:
     """Permutation sorting flows by (cell, slot) — groups each cell's flows
     contiguously in slot order.  Keys are made unique with the slot index,
-    so no stability assumption is needed.  O(M log M) work on [M] vectors,
-    replacing the former [M, num_cells] one-hot cumsum matrices that scaled
-    as O(M*N*S) / O(M*E) per substep and throttled 64-200-node topologies
-    (BASELINE ladder rungs 4-5)."""
+    so no stability assumption is needed.  Division of labor on TPU: the
+    SORT does the grouping (vectorized bitonic network), while all data
+    movement along the resulting permutation runs as [M, M] one-hot dots
+    (see ``_onehot``) — deliberately O(M^2) MXU work per substep, which
+    beats the serial per-index gathers/scatters it replaces by ~8x on the
+    measured chip."""
     m = cell_id.shape[0]
     return jnp.argsort(cell_id * m + jnp.arange(m))
 
@@ -150,13 +193,18 @@ def _rank_in_cell(cell_id: jnp.ndarray, mask: jnp.ndarray,
                   num_cells: int) -> jnp.ndarray:
     """rank[m] = #(flows m'<m with mask and same cell).  [M] i32.
     Only meaningful under ``mask`` (masked-out flows rank in a sentinel
-    cell)."""
+    cell).  Permutation gathers/scatters run as one-hot dots (see
+    ``_onehot``)."""
     m = cell_id.shape[0]
     key = jnp.where(mask, cell_id, num_cells)
     order = _group_order(key)
-    starts = _run_starts(key[order])
-    rank_sorted = (jnp.arange(m) - starts).astype(jnp.int32)
-    return jnp.zeros(m, jnp.int32).at[order].set(rank_sorted)
+    perm = _onehot(order, m)
+    key_sorted = jnp.round(jnp.dot(perm, key.astype(jnp.float32),
+                                   precision=_HI)).astype(key.dtype)
+    starts = _run_starts(key_sorted)
+    rank_sorted = (jnp.arange(m) - starts).astype(jnp.float32)
+    return jnp.round(jnp.dot(rank_sorted, perm, precision=_HI)
+                     ).astype(jnp.int32)
 
 
 class SimEngine:
@@ -328,7 +376,8 @@ class SimEngine:
             num_path_delay=m.num_path_delay + n_arr,
             run_path_delay_sum=m.run_path_delay_sum + path_add,
         )
-        chain_len = jnp.asarray(self.tables.chain_len)[F.sfc]
+        chain_len = _take(jnp.asarray(self.tables.chain_len),
+                          _onehot(jnp.clip(F.sfc, 0, self.C - 1), self.C))
         to_eg_flag = position >= chain_len             # forward_to_eg
         depart_hop = arrived & to_eg_flag              # reached egress: success
         need_proc_a = arrived & ~to_eg_flag
@@ -343,9 +392,11 @@ class SimEngine:
         n_free = free.sum()
         arr_rank = jnp.cumsum(due.astype(jnp.int32)) - 1
         spawn = due & (arr_rank < n_free)
-        # slot_of_rank[r] = slot index of the r-th free slot
-        slot_of_rank = jnp.zeros(self.M, jnp.int32).at[
-            jnp.where(free, free_rank, self.M)].set(slots, mode="drop")
+        # slot_of_rank[r] = slot index of the r-th free slot (one-hot
+        # transpose scatter; the [A]-sized rank gather stays native)
+        oh_rank = _onehot(jnp.where(free, free_rank, self.M), self.M)
+        slot_of_rank = jnp.round(jnp.dot(slots.astype(jnp.float32), oh_rank,
+                                         precision=_HI)).astype(jnp.int32)
         tgt = slot_of_rank[jnp.clip(arr_rank, 0, self.M - 1)]
 
         # one packed scatter per dtype instead of 11 per-field scatters —
@@ -391,7 +442,9 @@ class SimEngine:
         )
 
         # recompute flags after arrivals
-        chain_len = jnp.asarray(self.tables.chain_len)[sfc]
+        sfc_c = jnp.clip(sfc, 0, self.C - 1)
+        oh_sfc = _onehot(sfc_c, self.C)
+        chain_len = _take(jnp.asarray(self.tables.chain_len), oh_sfc)
         to_eg_flag = position >= chain_len
 
         # --- 4. decisions ---------------------------------------------------
@@ -408,9 +461,16 @@ class SimEngine:
         wrr = decide & ~to_eg_flag
 
         sf_pos = jnp.clip(position, 0, self.S - 1)
-        sf_now = jnp.asarray(self.tables.chain_sf)[jnp.clip(sfc, 0, self.C - 1),
-                                                   sf_pos]
+        oh_cs = _onehot(sfc_c * self.S + sf_pos, self.C * self.S)
+        sf_now = _take(jnp.asarray(self.tables.chain_sf).reshape(-1), oh_cs)
         sf_now = jnp.clip(sf_now, 0)
+        oh_node = _onehot(node, self.N)                # [M, N]
+        oh_sf = _onehot(sf_now, self.P)                # [M, P]
+        # (node, sfc, sf_pos) cell one-hot, shared by the WRR table reads,
+        # the counter updates, and the requested-traffic metric
+        cell = (node * self.C + sfc_c) * self.S + sf_pos
+        ncs = self.N * self.C * self.S
+        oh_cell = _onehot(cell, ncs)                   # [M, NCS]
         placed = state.placed
         sf_startup = state.sf_startup
         sf_last_active = state.sf_last_active
@@ -418,33 +478,34 @@ class SimEngine:
             # requested-traffic metric for every WRR decision, before the
             # schedule lookup (add_requesting_flow,
             # default_decision_maker.py:35-36)
-            m = m.replace(run_requested=m.run_requested.at[
-                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_pos
-            ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
+            req_add = jnp.dot(jnp.where(wrr, dr, 0.0), oh_cell,
+                              precision=_HI).reshape(m.run_requested.shape)
+            m = m.replace(run_requested=m.run_requested + req_add)
 
             # WRR over the schedule row with realized-ratio counters
             # (default_decision_maker.py:42-66); same-cell same-substep
             # collisions run in slot-order rounds so later flows see updated
             # counters
-            cell = (node * self.C + jnp.clip(sfc, 0)) * self.S + sf_pos
-            rank = _rank_in_cell(cell, wrr, self.N * self.C * self.S)
+            rank = _rank_in_cell(cell, wrr, ncs)
             flow_counts = m.run_flow_counts
+            # schedule rows are loop-invariant (indexed by chain POSITION;
+            # its SF axis mirrors the action layout, environment_limits.py:
+            # 44-51)
+            probs = _take(state.schedule.reshape(ncs, self.N), oh_cell)
             R = self.cfg.wrr_rank_levels
             for r in range(R):
                 sel = wrr & ((rank == r) if r < R - 1 else (rank >= r))
-                counts = flow_counts[node, jnp.clip(sfc, 0), sf_pos]  # [M,N]
+                counts = _take(flow_counts.reshape(ncs, self.N), oh_cell)
                 total = counts.sum(-1, keepdims=True)
                 ratios = jnp.where(total > 0, counts / jnp.maximum(total, 1), 0.0)
-                # schedule tensor is indexed by chain POSITION (its SF axis
-                # mirrors the action layout, environment_limits.py:44-51)
-                probs = state.schedule[node, jnp.clip(sfc, 0), sf_pos]
                 diffs = jnp.where(probs > 0, probs - ratios, -1.0)
                 choice = jnp.argmax(diffs, axis=-1).astype(jnp.int32)
                 dest = jnp.where(sel, choice, dest)
-                flow_counts = flow_counts.at[
-                    jnp.where(sel, node, self.N), jnp.clip(sfc, 0), sf_pos,
-                    choice
-                ].add(jnp.where(sel, 1, 0), mode="drop")
+                cnt_add = jnp.einsum(
+                    "mc,mn->cn", oh_cell * sel[:, None].astype(jnp.float32),
+                    _onehot(choice, self.N), precision=_HI)
+                flow_counts = flow_counts + jnp.round(cnt_add).astype(
+                    flow_counts.dtype).reshape(flow_counts.shape)
             m = m.replace(run_flow_counts=flow_counts)
         else:
             # per-flow external control: only flows with a provided decision
@@ -453,13 +514,14 @@ class SimEngine:
             has_dec = ext_decisions >= 0
             wrr = wrr & has_dec
             dest = jnp.where(wrr, jnp.clip(ext_decisions, 0, self.N - 1), dest)
-            m = m.replace(run_requested=m.run_requested.at[
-                jnp.where(wrr, node, self.N), jnp.clip(sfc, 0), sf_pos
-            ].add(jnp.where(wrr, dr, 0.0), mode="drop"))
+            req_add = jnp.dot(jnp.where(wrr, dr, 0.0), oh_cell,
+                              precision=_HI).reshape(m.run_requested.shape)
+            m = m.replace(run_requested=m.run_requested + req_add)
             # place-on-decision (flow_controller.py:46-60): install the SF at
             # the decided node if absent, stamping its startup time
-            newly_placed = jnp.zeros((self.N, self.P), bool).at[
-                jnp.where(wrr, dest, self.N), sf_now].max(wrr, mode="drop")
+            newly_placed = jnp.einsum(
+                "mn,mp->np", _onehot(dest, self.N) * wrr[:, None].astype(
+                    jnp.float32), oh_sf, precision=_HI) > 0.5
             newly_placed = newly_placed & ~placed
             placed = placed | newly_placed
             fresh = newly_placed & ~sf_available
@@ -474,7 +536,14 @@ class SimEngine:
         depart_stay = to_eg & stay                    # at egress already
         need_proc_b = wrr & stay
         start_path = fwd & ~stay
-        pd_path = topo.path_delay[node, jnp.clip(dest, 0)]
+        # [N,N] tables read as one-hot row select + per-row column pick;
+        # inf path delays (unreachable) become a big finite value so the
+        # 0*inf=NaN dot hazard never arises — every use compares against
+        # TTL (<= 1e4), for which 1e30 and inf behave identically
+        oh_dest = _onehot(jnp.clip(dest, 0), self.N)
+        pd_tab = jnp.where(jnp.isfinite(topo.path_delay), topo.path_delay,
+                           1e30)
+        pd_path = _pick(_take(pd_tab, oh_node), oh_dest)
         # upfront whole-path TTL check (default_forwarder.py:35-39);
         # unreachable destinations have inf path delay and also drop here
         drop_ttl_path = start_path & (ttl - pd_path <= _EPS)
@@ -483,40 +552,53 @@ class SimEngine:
 
         # hop starts this substep: fresh paths + mid-path continuations
         hop_req = cont | start_path
-        nh = topo.next_hop[node, jnp.clip(dest, 0)]
+        nh = _pick(_take(topo.next_hop, oh_node), oh_dest)
         nh = jnp.clip(nh, 0)
-        eid = topo.adj_edge_id[node, nh]
+        eid = _pick(_take(topo.adj_edge_id, oh_node), _onehot(nh, self.N))
         eid_c = jnp.clip(eid, 0)
+        oh_e = _onehot(eid_c, self.E)                  # [M, E]
         # greedy slot-order link admission via iterative refinement
         # (deduct_link_resources, default_forwarder.py:95-111).  The edge
         # grouping is fixed across iterations (only ``admitted`` changes),
-        # so sort once and redo only the masked cumsum per iteration.
+        # so sort once and redo only the masked cumsum per iteration; all
+        # permutation gathers/scatters are one-hot dots.
         order_e = _group_order(eid_c)
-        starts_e = _run_starts(eid_c[order_e])
-        req_s = (hop_req & (eid >= 0))[order_e]
-        dr_s = dr[order_e]
-        headroom_s = (topo.edge_cap[eid_c] - edge_used[eid_c] + _EPS)[order_e]
+        perm_e = _onehot(order_e, self.M)              # [M, M]
+        headroom = _take(topo.edge_cap - edge_used + _EPS, oh_e)  # [M]
+        sort_in = jnp.stack(
+            [eid_c.astype(jnp.float32),
+             (hop_req & (eid >= 0)).astype(jnp.float32), dr, headroom],
+            axis=-1)                                   # [M, 4]
+        sorted_cols = jnp.dot(perm_e, sort_in, precision=_HI)
+        eid_s = jnp.round(sorted_cols[:, 0]).astype(jnp.int32)
+        req_s = sorted_cols[:, 1] > 0.5
+        dr_s = sorted_cols[:, 2]
+        headroom_s = sorted_cols[:, 3]
+        starts_e = _run_starts(eid_s)
+        oh_starts_e = _onehot(starts_e, self.M)
         adm_s = req_s
         for _ in range(self.cfg.admission_iters):
             v = jnp.where(adm_s, dr_s, 0.0)
             cs = jnp.cumsum(v)
-            prefix_sorted = cs - (cs[starts_e] - v[starts_e])
-            adm_s = req_s & (prefix_sorted <= headroom_s)
-        admitted = jnp.zeros(self.M, bool).at[order_e].set(adm_s)
+            bound = jnp.dot(oh_starts_e, jnp.stack([cs, v], axis=-1),
+                            precision=_HI)
+            adm_s = req_s & (cs - (bound[:, 0] - bound[:, 1]) <= headroom_s)
+        admitted = jnp.dot(adm_s.astype(jnp.float32), perm_e,
+                           precision=_HI) > 0.5
         drop_link = hop_req & ~admitted
         add_e = jnp.where(admitted, dr, 0.0)
-        edge_used = edge_used.at[jnp.where(admitted, eid_c, self.E)].add(
-            add_e, mode="drop")
-        m = m.replace(run_passed_traffic=m.run_passed_traffic.at[
-            jnp.where(admitted, eid_c, self.E)].add(add_e, mode="drop"))
-        hop_delay = topo.edge_delay[eid_c]
+        edge_add = jnp.dot(add_e, oh_e, precision=_HI)  # [E]
+        edge_used = edge_used + edge_add
+        m = m.replace(run_passed_traffic=m.run_passed_traffic + edge_add)
+        hop_delay = _take(topo.edge_delay, oh_e)
         # release link capacity hop_delay + duration after the hop starts
         # (default_forwarder.py:112-125)
         off_e = jnp.clip(jnp.ceil((hop_delay + duration) / dt).astype(jnp.int32),
                          1, self.H - 1)
-        rel_edge = rel_edge.at[
-            jnp.where(admitted, jnp.mod(ridx + off_e, self.H), self.H),
-            jnp.where(admitted, eid_c, self.E)].add(add_e, mode="drop")
+        oh_off_e = _onehot(jnp.where(admitted, jnp.mod(ridx + off_e, self.H),
+                                     self.H), self.H)  # [M, H]
+        rel_edge = rel_edge + jnp.einsum(
+            "mh,me->he", oh_off_e, oh_e * add_e[:, None], precision=_HI)
         pend_path = jnp.where(start_path & admitted, pd_path, pend_path)
         hop_next = jnp.where(admitted, nh, hop_next)
         timer = jnp.where(admitted, hop_delay, timer)
@@ -524,13 +606,17 @@ class SimEngine:
 
         # --- 6. processing --------------------------------------------------
         need_proc = need_proc_a | need_proc_b
-        sf_ok = placed[node, sf_now]
+        sf_ok = _pick(_take(placed, oh_node), oh_sf)
         # SF not in placement -> drop (default_processor.py:48-50 ->
         # NODE_CAP, flowsimulator.py:114-118)
         drop_unplaced = need_proc & ~sf_ok
         want = need_proc & sf_ok
-        pmean = jnp.asarray(self.tables.proc_mean)[sf_now]
-        pstd = jnp.asarray(self.tables.proc_std)[sf_now]
+        proc_tab = _take(jnp.stack(
+            [jnp.asarray(self.tables.proc_mean),
+             jnp.asarray(self.tables.proc_std),
+             jnp.asarray(self.tables.startup_delay)], axis=-1), oh_sf)
+        pmean = proc_tab[:, 0]
+        pstd = proc_tab[:, 1]
         pdel = jnp.abs(jax.random.normal(k_proc, (self.M,)) * pstd + pmean)
         # TTL check before the delay is credited (base_processor.py:37-44)
         drop_ttl_pd = want & (ttl - pdel <= _EPS)
@@ -550,42 +636,52 @@ class SimEngine:
         # across refinement iters, with a single [M,P] cumsum per iter — no
         # [M, N*S] materialization, no per-SF Python loop.
         node_order = _group_order(node)
-        node_sorted = node[node_order]
+        perm_n = _onehot(node_order, self.M)                   # [M, M]
+        cap_mine = _take(cap_now[:, None], oh_node)[:, 0]      # [M]
+        sort_cols = jnp.dot(perm_n, jnp.stack(
+            [node.astype(jnp.float32), want.astype(jnp.float32), dr,
+             cap_mine], axis=-1), precision=_HI)
+        node_sorted = jnp.round(sort_cols[:, 0]).astype(jnp.int32)
+        want_s = sort_cols[:, 1] > 0.5
+        dr_col_s = sort_cols[:, 2][:, None]
+        cap_s = sort_cols[:, 3]
         starts_node = _run_starts(node_sorted)
-        base_load_s = node_load[node_sorted]                   # [M,P]
-        avail_s = sf_available[node_sorted]                    # [M,P]
-        cap_s = cap_now[node_sorted]
-        want_s = want[node_order]
-        dr_col_s = dr[node_order][:, None]
-        sf_onehot_s = (sf_now[node_order][:, None]
-                       == jnp.arange(self.P)[None, :])         # [M,P]
+        oh_starts_n = _onehot(starts_node, self.M)
+        oh_ns = _onehot(node_sorted, self.N)
+        base_load_s = _take(node_load, oh_ns)                  # [M,P]
+        avail_s = _take(sf_available, oh_ns)                   # [M,P]
+        sf_onehot_s = jnp.dot(perm_n, oh_sf, precision=_HI) > 0.5
         adm_ns = want_s
         dem_s = jnp.zeros(self.M, jnp.float32)
         for _ in range(self.cfg.admission_iters):
             v = jnp.where(adm_ns[:, None] & sf_onehot_s, dr_col_s, 0.0)
             cs = jnp.cumsum(v, axis=0)
-            pref_sorted = cs - (cs[starts_node] - v[starts_node])
-            dem_s = self._demanded(base_load_s + pref_sorted, avail_s)
+            b_cs = jnp.dot(oh_starts_n, cs, precision=_HI)
+            b_v = jnp.dot(oh_starts_n, v, precision=_HI)
+            dem_s = self._demanded(base_load_s + cs - (b_cs - b_v), avail_s)
             adm_ns = want_s & (dem_s <= cap_s + _EPS)
-        admitted_n = jnp.zeros(self.M, bool).at[node_order].set(adm_ns)
-        demanded = jnp.zeros(self.M, jnp.float32).at[node_order].set(dem_s)
+        unsorted = jnp.dot(
+            jnp.stack([adm_ns.astype(jnp.float32), dem_s], axis=-1).T,
+            perm_n, precision=_HI)                             # [2, M]
+        admitted_n = unsorted[0] > 0.5
+        demanded = unsorted[1]
         drop_nodecap = want & ~admitted_n
         add_n = jnp.where(admitted_n, dr, 0.0)
-        node_load = node_load.at[
-            jnp.where(admitted_n, node, self.N), sf_now].add(add_n, mode="drop")
+        node_add = jnp.einsum("mn,mp->np", oh_node * add_n[:, None], oh_sf,
+                              precision=_HI)                   # [N, P]
+        node_load = node_load + node_add
         m = m.replace(
-            run_processed_traffic=m.run_processed_traffic.at[
-                jnp.where(admitted_n, node, self.N), sf_now
-            ].add(add_n, mode="drop"),
-            run_max_node_usage=m.run_max_node_usage.at[
-                jnp.where(admitted_n, node, self.N)
-            ].max(jnp.where(admitted_n, demanded, 0.0), mode="drop"),
+            run_processed_traffic=m.run_processed_traffic + node_add,
+            run_max_node_usage=jnp.maximum(
+                m.run_max_node_usage,
+                (oh_node * jnp.where(admitted_n, demanded, 0.0)[:, None]
+                 ).max(axis=0)),
         )
         # startup wait (base_processor.py:79-97); a TTL expiry here releases
         # the load immediately (divergence: the reference leaks it)
         sw = jnp.maximum(
-            sf_startup[node, sf_now]
-            + jnp.asarray(self.tables.startup_delay)[sf_now] - t, 0.0)
+            _pick(_take(sf_startup, oh_node), oh_sf)
+            + proc_tab[:, 2] - t, 0.0)
         drop_ttl_sw = admitted_n & (ttl - sw <= _EPS) & (sw > _EPS)
         ttl = jnp.where(drop_ttl_sw, 0.0, ttl)
         started = admitted_n & ~drop_ttl_sw
@@ -600,10 +696,13 @@ class SimEngine:
         hold = jnp.where(started, busy + duration, dt)
         rel_who = started | drop_ttl_sw
         off_n = jnp.clip(jnp.ceil(hold / dt).astype(jnp.int32), 1, self.H - 1)
-        rel_node = rel_node.at[
-            jnp.where(rel_who, jnp.mod(ridx + off_n, self.H), self.H),
-            jnp.where(rel_who, node, self.N), sf_now
-        ].add(jnp.where(rel_who, dr, 0.0), mode="drop")
+        oh_off_n = _onehot(jnp.where(rel_who, jnp.mod(ridx + off_n, self.H),
+                                     self.H), self.H)          # [M, H]
+        rel_vals = jnp.where(rel_who, dr, 0.0)
+        rel_node = rel_node + jnp.einsum(
+            "mh,mnp->hnp", oh_off_n,
+            jnp.einsum("mn,mp->mnp", oh_node * rel_vals[:, None], oh_sf,
+                       precision=_HI), precision=_HI)
 
         # --- 7. departures & drops -----------------------------------------
         depart = depart_hop | depart_stay
@@ -642,9 +741,9 @@ class SimEngine:
             dropped=m.dropped + n_drop,
             run_dropped=m.run_dropped + n_drop,
             active=m.active - n_drop,
-            run_dropped_per_node=m.run_dropped_per_node.at[
-                jnp.where(any_drop, node, self.N)
-            ].add(jnp.where(any_drop, 1, 0), mode="drop"),
+            run_dropped_per_node=m.run_dropped_per_node + jnp.round(
+                jnp.dot(any_drop.astype(jnp.float32), oh_node,
+                        precision=_HI)).astype(m.run_dropped_per_node.dtype),
         )
         gone = depart | any_drop
         phase = jnp.where(gone, PH_FREE, phase)
