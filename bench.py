@@ -178,8 +178,27 @@ def _rung4_stack(episode_steps):
 
     env, agent, topo, _ = _flagship(
         max_nodes=64, max_edges=128, episode_steps=episode_steps,
-        max_flows=512, spec=random_network(64, seed=7))
+        max_flows=512, spec=random_network(64, seed=7), gen_traffic=False)
     return env, agent, topo
+
+
+def _interroute_stack(episode_steps):
+    """Interoute (Topology Zoo, 110 nodes / 146 edges — the reference's
+    largest REAL scenario, configs/networks/interroute/), 1024 flow slots.
+    Note this is NOT BASELINE config 5 (200+-node synthetic + mixed SFC
+    catalog, covered by tests/test_rung5.py) — it benchmarks the biggest
+    network the reference actually ships."""
+    from __graft_entry__ import _flagship
+    from gsc_tpu.topology.synthetic import interroute
+
+    env, agent, topo, _ = _flagship(
+        max_nodes=128, max_edges=192, episode_steps=episode_steps,
+        max_flows=1024, spec=interroute(), gen_traffic=False)
+    return env, agent, topo
+
+
+# scenario name -> stack builder; 'flagship' is handled inline in worker()
+STACKS = {"rung4": _rung4_stack, "interroute": _interroute_stack}
 
 
 def worker(replicas: int, chunk: int, episodes: int,
@@ -191,16 +210,17 @@ def worker(replicas: int, chunk: int, episodes: int,
     from gsc_tpu.parallel import ParallelDDPG
     from gsc_tpu.sim.traffic import generate_traffic
 
-    if scenario not in ("flagship", "rung4"):
-        raise SystemExit(f"unknown scenario {scenario!r} "
-                         "(expected 'flagship' or 'rung4')")
+    if scenario != "flagship" and scenario not in STACKS:
+        raise SystemExit(f"unknown scenario {scenario!r} (expected "
+                         f"'flagship' or one of {sorted(STACKS)})")
     assert EPISODE_STEPS % chunk == 0, (EPISODE_STEPS, chunk)
     chunks_per_ep = EPISODE_STEPS // chunk
     t_start = time.time()
-    if scenario == "rung4":
-        env, agent, topo = _rung4_stack(EPISODE_STEPS)
+    if scenario in STACKS:
+        env, agent, topo = STACKS[scenario](EPISODE_STEPS)
     else:
-        env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS)
+        env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS,
+                                        gen_traffic=False)
     B = replicas
     traffic = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs),

@@ -164,3 +164,29 @@ def _run_rung5_sharded():
         jax.block_until_ready((stats, metrics))
     assert np.isfinite(float(stats["episodic_return"]))
     assert np.isfinite(float(metrics["critic_loss"]))
+
+
+def test_bench_interroute_scenario_builds_and_steps():
+    """The bench.py interroute scenario (110n/146e, 1024 flow slots)
+    constructs and rolls one 2-step episode through the parallel path."""
+    import jax.numpy as jnp
+
+    from bench import _interroute_stack
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim import generate_traffic
+
+    env, agent, topo = _interroute_stack(episode_steps=2)
+    assert int(np.asarray(topo.node_mask).sum()) == 110
+    B = 2
+    traffic = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[generate_traffic(env.sim_cfg, env.service, topo, 2, seed=s)
+          for s in range(B)])
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, sample_mode="local")
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+    state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
+    assert np.isfinite(float(stats["episodic_return"]))
