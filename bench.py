@@ -188,12 +188,22 @@ def _interroute_stack(episode_steps):
     Note this is NOT BASELINE config 5 (200+-node synthetic + mixed SFC
     catalog, covered by tests/test_rung5.py) — it benchmarks the biggest
     network the reference actually ships."""
+    import dataclasses
+
     from __graft_entry__ import _flagship
     from gsc_tpu.topology.synthetic import interroute
 
     env, agent, topo, _ = _flagship(
         max_nodes=128, max_edges=192, episode_steps=episode_steps,
         max_flows=1024, spec=interroute(), gen_traffic=False)
+    # at 128 max nodes the action/mask dim is 128*1*3*128 = 49k floats per
+    # transition, and the flagship mem_limit=10000 OOMs one chip's HBM at
+    # B=32 (312 transitions/replica, measured RESOURCE_EXHAUSTED in the
+    # learn burst).  This cap makes per-replica capacity floor at
+    # batch_size=100 (ParallelDDPG.init_buffers), which fits and ran at
+    # 99 env-steps/s; it changes nothing at B >= 100 where the floor
+    # already binds.
+    agent = dataclasses.replace(agent, mem_limit=2048)
     return env, agent, topo
 
 

@@ -246,3 +246,42 @@ def test_load_and_release(base):
     assert float(jnp.abs(state2.edge_used).max()) < 1e-3
     # max node usage observed during interval 1 should be >= 1 flow's demand
     assert float(out[0].run_max_node_usage[1]) >= 1.0
+
+
+def test_onehot_helpers_match_native_indexing():
+    """_onehot/_take/_pick (the TPU one-hot data-movement primitives)
+    reproduce native gather semantics exactly — f32/i32/bool tables,
+    out-of-range drop rows, and permutation transpose-scatter."""
+
+    from gsc_tpu.sim.engine import _onehot, _pick, _take
+
+    rng = np.random.default_rng(0)
+    M, N, P = 37, 11, 5
+    idx = jnp.asarray(rng.integers(0, N, M), jnp.int32)
+    ftab = jnp.asarray(rng.normal(size=(N, P)), jnp.float32)
+    itab = jnp.asarray(rng.integers(-3, 99, (N, P)), jnp.int32)
+    btab = jnp.asarray(rng.integers(0, 2, (N, P)).astype(bool))
+    oh = _onehot(idx, N)
+    for tab in (ftab, itab, btab):
+        got = np.asarray(_take(tab, oh))
+        want = np.asarray(tab)[np.asarray(idx)]
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got, want)
+    # out-of-range index -> all-zero row (mode="drop" analogue)
+    oh_drop = _onehot(jnp.full((3,), N, jnp.int32), N)
+    np.testing.assert_array_equal(np.asarray(_take(ftab, oh_drop)), 0.0)
+    # _pick: per-row column select
+    cols = jnp.asarray(rng.integers(0, P, M), jnp.int32)
+    rows = _take(ftab, oh)                       # [M, P]
+    got = np.asarray(_pick(rows, _onehot(cols, P)))
+    want = np.asarray(rows)[np.arange(M), np.asarray(cols)]
+    np.testing.assert_array_equal(got, want)
+    # permutation: P @ v sorts, v^T @ P inverse-scatters back
+    perm = jnp.asarray(rng.permutation(M), jnp.int32)
+    pm = _onehot(perm, M)
+    v = jnp.asarray(rng.normal(size=M), jnp.float32)
+    sorted_v = jnp.dot(pm, v, precision=jax.lax.Precision.HIGHEST)
+    np.testing.assert_array_equal(np.asarray(sorted_v),
+                                  np.asarray(v)[np.asarray(perm)])
+    back = jnp.dot(sorted_v, pm, precision=jax.lax.Precision.HIGHEST)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(v))
