@@ -207,8 +207,53 @@ def _interroute_stack(episode_steps):
     return env, agent, topo
 
 
+def mixed_service():
+    """Mixed SFC catalog for BASELINE config 5 — two chains over a shared
+    5-SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms).  Single source of truth;
+    tests/test_rung5.py imports this."""
+    from gsc_tpu.config.schema import ServiceConfig, ServiceFunction
+
+    mk = lambda n, d: ServiceFunction(name=n, processing_delay_mean=d,
+                                      processing_delay_stdev=0.0)
+    return ServiceConfig(
+        sfc_list={"sfc_1": ("a", "b", "c"), "sfc_2": ("d", "e")},
+        sf_list={"a": mk("a", 5.0), "b": mk("b", 5.0), "c": mk("c", 5.0),
+                 "d": mk("d", 8.0), "e": mk("e", 2.0)})
+
+
+def _rung5_stack(episode_steps):
+    """BASELINE ladder rung 5 (BASELINE.md config 5): 200-node synthetic
+    multi-cloud topology + the ``mixed_service`` catalog, 1024 flow
+    slots.  Replay capped like the interroute stack (the action/mask dim
+    is 256*2*3*256 = 393k floats per transition)."""
+    from gsc_tpu.config.schema import AgentConfig, EnvLimits, SimConfig
+    from gsc_tpu.env.env import ServiceCoordEnv
+    from gsc_tpu.topology.compiler import compile_topology
+    from gsc_tpu.topology.synthetic import random_network
+
+    service = mixed_service()
+    limits = EnvLimits.for_service(service, max_nodes=256, max_edges=384)
+    # 393k floats per action/mask make the flagship hyperparameters
+    # unaffordable here: with actor hidden 256 the output layer alone is
+    # 100M params, and params+targets+Adam+grads+replay measured
+    # RESOURCE_EXHAUSTED in the learn burst even at B=4.  Scenario
+    # hyperparameters: smaller nets (25M-param actor head), 32-sample
+    # batches, replay of max(512 // B, 32) transitions per replica
+    # (64 at the measured B=8, the batch_size floor of 32 at B=16).
+    agent = AgentConfig(graph_mode=True, episode_steps=episode_steps,
+                        objective="prio-flow", mem_limit=512, batch_size=32,
+                        actor_hidden_layer_nodes=(64,),
+                        critic_hidden_layer_nodes=(32,))
+    sim_cfg = SimConfig(ttl_choices=(100.0,), max_flows=1024)
+    env = ServiceCoordEnv(service, sim_cfg, agent, limits)
+    topo = compile_topology(random_network(200, num_ingress=8, seed=11),
+                            max_nodes=256, max_edges=384)
+    return env, agent, topo
+
+
 # scenario name -> stack builder; 'flagship' is handled inline in worker()
-STACKS = {"rung4": _rung4_stack, "interroute": _interroute_stack}
+STACKS = {"rung4": _rung4_stack, "interroute": _interroute_stack,
+          "rung5": _rung5_stack}
 
 
 def worker(replicas: int, chunk: int, episodes: int,

@@ -23,13 +23,11 @@ from gsc_tpu.utils.debug import assert_invariants
 
 
 def mixed_service() -> ServiceConfig:
-    """Two chains over a shared SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms)."""
-    mk = lambda n, d: ServiceFunction(name=n, processing_delay_mean=d,
-                                      processing_delay_stdev=0.0)
-    return ServiceConfig(
-        sfc_list={"sfc_1": ("a", "b", "c"), "sfc_2": ("d", "e")},
-        sf_list={"a": mk("a", 5.0), "b": mk("b", 5.0), "c": mk("c", 5.0),
-                 "d": mk("d", 8.0), "e": mk("e", 2.0)})
+    """Two chains over a shared SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms).
+    Single source of truth lives next to the benchmark that measures it."""
+    from bench import mixed_service as _ms
+
+    return _ms()
 
 
 def test_mixed_sfc_catalog_engine():
@@ -190,3 +188,19 @@ def test_bench_interroute_scenario_builds_and_steps():
     state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
         state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
     assert np.isfinite(float(stats["episodic_return"]))
+
+
+def test_bench_rung5_scenario_matches_config5():
+    """The bench.py rung5 scenario IS BASELINE config 5: 200-node
+    synthetic topology, mixed 2-chain catalog over a 5-SF pool."""
+    from bench import _rung5_stack
+
+    env, agent, topo = _rung5_stack(episode_steps=2)
+    assert int(np.asarray(topo.node_mask).sum()) == 200
+    assert env.limits.num_sfcs == 2 and env.limits.sf_pool == 5
+    assert set(env.service.sfc_list) == {"sfc_1", "sfc_2"}
+    assert env.sim_cfg.max_flows == 1024
+    # scenario hyperparameters sized to fit one chip's HBM at the 393k-dim
+    # padded action (see the constructor's comment)
+    assert agent.mem_limit == 512 and agent.batch_size == 32
+    assert agent.actor_hidden_layer_nodes == (64,)
