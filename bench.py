@@ -207,25 +207,12 @@ def _interroute_stack(episode_steps):
     return env, agent, topo
 
 
-def mixed_service():
-    """Mixed SFC catalog for BASELINE config 5 — two chains over a shared
-    5-SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms).  Single source of truth;
-    tests/test_rung5.py imports this."""
-    from gsc_tpu.config.schema import ServiceConfig, ServiceFunction
-
-    mk = lambda n, d: ServiceFunction(name=n, processing_delay_mean=d,
-                                      processing_delay_stdev=0.0)
-    return ServiceConfig(
-        sfc_list={"sfc_1": ("a", "b", "c"), "sfc_2": ("d", "e")},
-        sf_list={"a": mk("a", 5.0), "b": mk("b", 5.0), "c": mk("c", 5.0),
-                 "d": mk("d", 8.0), "e": mk("e", 2.0)})
-
-
 def _rung5_stack(episode_steps):
     """BASELINE ladder rung 5 (BASELINE.md config 5): 200-node synthetic
     multi-cloud topology + the ``mixed_service`` catalog, 1024 flow
     slots.  Replay capped like the interroute stack (the action/mask dim
     is 256*2*3*256 = 393k floats per transition)."""
+    from gsc_tpu.config.catalog import mixed_service
     from gsc_tpu.config.schema import AgentConfig, EnvLimits, SimConfig
     from gsc_tpu.env.env import ServiceCoordEnv
     from gsc_tpu.topology.compiler import compile_topology

@@ -1,0 +1,29 @@
+"""Canonical benchmark service catalogs.
+
+The reference ships these as YAML assets (configs/service_functions/abc.yaml
+and variants); programmatic builders keep one source of truth for the
+benchmark scenarios, the tests, and the driver entry points.
+"""
+from __future__ import annotations
+
+from .schema import ServiceConfig, ServiceFunction
+
+
+def abc_service() -> ServiceConfig:
+    """The reference's abc chain: a->b->c, 5 ms mean processing each
+    (configs/service_functions/abc.yaml:4-21)."""
+    sf = lambda n: ServiceFunction(name=n, processing_delay_mean=5.0,
+                                   processing_delay_stdev=0.0)
+    return ServiceConfig(sfc_list={"sfc_1": ("a", "b", "c")},
+                         sf_list={n: sf(n) for n in "abc"})
+
+
+def mixed_service() -> ServiceConfig:
+    """Mixed SFC catalog for BASELINE config 5 — two chains over a shared
+    5-SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms)."""
+    mk = lambda n, d: ServiceFunction(name=n, processing_delay_mean=d,
+                                      processing_delay_stdev=0.0)
+    return ServiceConfig(
+        sfc_list={"sfc_1": ("a", "b", "c"), "sfc_2": ("d", "e")},
+        sf_list={"a": mk("a", 5.0), "b": mk("b", 5.0), "c": mk("c", 5.0),
+                 "d": mk("d", 8.0), "e": mk("e", 2.0)})

@@ -536,14 +536,26 @@ class SimEngine:
         depart_stay = to_eg & stay                    # at egress already
         need_proc_b = wrr & stay
         start_path = fwd & ~stay
-        # [N,N] tables read as one-hot row select + per-row column pick;
-        # inf path delays (unreachable) become a big finite value so the
-        # 0*inf=NaN dot hazard never arises — every use compares against
-        # TTL (<= 1e4), for which 1e30 and inf behave identically
+        # All node-indexed table rows come out of ONE wide one-hot dot:
+        # [path_delay | next_hop | adj_edge_id | cap_now] is loop-invariant
+        # (XLA hoists the concat out of the substep scan), so 4 gather-dots
+        # collapse into a single [M,N]@[N,3N+1] contraction.  inf path
+        # delays (unreachable) become a big finite value so the 0*inf=NaN
+        # dot hazard never arises — every use compares against TTL
+        # (<= 1e4), for which 1e30 and inf behave identically.
         oh_dest = _onehot(jnp.clip(dest, 0), self.N)
         pd_tab = jnp.where(jnp.isfinite(topo.path_delay), topo.path_delay,
                            1e30)
-        pd_path = _pick(_take(pd_tab, oh_node), oh_dest)
+        static_tab = jnp.concatenate(
+            [pd_tab, topo.next_hop.astype(jnp.float32),
+             topo.adj_edge_id.astype(jnp.float32), cap_now[:, None]],
+            axis=1)                                    # [N, 3N+1]
+        rows = jnp.dot(oh_node, static_tab, precision=_HI)  # [M, 3N+1]
+        pd_rows = rows[:, :self.N]
+        nh_rows = rows[:, self.N:2 * self.N]
+        adj_rows = rows[:, 2 * self.N:3 * self.N]
+        cap_mine = rows[:, 3 * self.N]
+        pd_path = (pd_rows * oh_dest).sum(-1)
         # upfront whole-path TTL check (default_forwarder.py:35-39);
         # unreachable destinations have inf path delay and also drop here
         drop_ttl_path = start_path & (ttl - pd_path <= _EPS)
@@ -552,9 +564,10 @@ class SimEngine:
 
         # hop starts this substep: fresh paths + mid-path continuations
         hop_req = cont | start_path
-        nh = _pick(_take(topo.next_hop, oh_node), oh_dest)
+        nh = jnp.round((nh_rows * oh_dest).sum(-1)).astype(jnp.int32)
         nh = jnp.clip(nh, 0)
-        eid = _pick(_take(topo.adj_edge_id, oh_node), _onehot(nh, self.N))
+        eid = jnp.round((adj_rows * _onehot(nh, self.N)).sum(-1)
+                        ).astype(jnp.int32)
         eid_c = jnp.clip(eid, 0)
         oh_e = _onehot(eid_c, self.E)                  # [M, E]
         # greedy slot-order link admission via iterative refinement
@@ -564,7 +577,10 @@ class SimEngine:
         # permutation gathers/scatters are one-hot dots.
         order_e = _group_order(eid_c)
         perm_e = _onehot(order_e, self.M)              # [M, M]
-        headroom = _take(topo.edge_cap - edge_used + _EPS, oh_e)  # [M]
+        edge_rows = _take(jnp.stack(
+            [topo.edge_cap - edge_used + _EPS, topo.edge_delay],
+            axis=-1), oh_e)                            # [M, 2]
+        headroom = edge_rows[:, 0]
         sort_in = jnp.stack(
             [eid_c.astype(jnp.float32),
              (hop_req & (eid >= 0)).astype(jnp.float32), dr, headroom],
@@ -590,7 +606,7 @@ class SimEngine:
         edge_add = jnp.dot(add_e, oh_e, precision=_HI)  # [E]
         edge_used = edge_used + edge_add
         m = m.replace(run_passed_traffic=m.run_passed_traffic + edge_add)
-        hop_delay = _take(topo.edge_delay, oh_e)
+        hop_delay = edge_rows[:, 1]
         # release link capacity hop_delay + duration after the hop starts
         # (default_forwarder.py:112-125)
         off_e = jnp.clip(jnp.ceil((hop_delay + duration) / dt).astype(jnp.int32),
@@ -606,7 +622,12 @@ class SimEngine:
 
         # --- 6. processing --------------------------------------------------
         need_proc = need_proc_a | need_proc_b
-        sf_ok = _pick(_take(placed, oh_node), oh_sf)
+        # [placed | sf_startup] rows in one dot (loop-variant in per-flow
+        # control mode, so kept separate from the static table above)
+        ps_rows = jnp.dot(oh_node, jnp.concatenate(
+            [placed.astype(jnp.float32), sf_startup], axis=1),
+            precision=_HI)                             # [M, 2P]
+        sf_ok = (ps_rows[:, :self.P] * oh_sf).sum(-1) > 0.5
         # SF not in placement -> drop (default_processor.py:48-50 ->
         # NODE_CAP, flowsimulator.py:114-118)
         drop_unplaced = need_proc & ~sf_ok
@@ -637,7 +658,6 @@ class SimEngine:
         # [M, N*S] materialization, no per-SF Python loop.
         node_order = _group_order(node)
         perm_n = _onehot(node_order, self.M)                   # [M, M]
-        cap_mine = _take(cap_now[:, None], oh_node)[:, 0]      # [M]
         sort_cols = jnp.dot(perm_n, jnp.stack(
             [node.astype(jnp.float32), want.astype(jnp.float32), dr,
              cap_mine], axis=-1), precision=_HI)
@@ -648,8 +668,11 @@ class SimEngine:
         starts_node = _run_starts(node_sorted)
         oh_starts_n = _onehot(starts_node, self.M)
         oh_ns = _onehot(node_sorted, self.N)
-        base_load_s = _take(node_load, oh_ns)                  # [M,P]
-        avail_s = _take(sf_available, oh_ns)                   # [M,P]
+        la_rows = jnp.dot(oh_ns, jnp.concatenate(
+            [node_load, sf_available.astype(jnp.float32)], axis=1),
+            precision=_HI)                             # [M, 2P]
+        base_load_s = la_rows[:, :self.P]
+        avail_s = la_rows[:, self.P:] > 0.5
         sf_onehot_s = jnp.dot(perm_n, oh_sf, precision=_HI) > 0.5
         adm_ns = want_s
         dem_s = jnp.zeros(self.M, jnp.float32)
@@ -680,7 +703,7 @@ class SimEngine:
         # startup wait (base_processor.py:79-97); a TTL expiry here releases
         # the load immediately (divergence: the reference leaks it)
         sw = jnp.maximum(
-            _pick(_take(sf_startup, oh_node), oh_sf)
+            (ps_rows[:, self.P:] * oh_sf).sum(-1)
             + proc_tab[:, 2] - t, 0.0)
         drop_ttl_sw = admitted_n & (ttl - sw <= _EPS) & (sw > _EPS)
         ttl = jnp.where(drop_ttl_sw, 0.0, ttl)

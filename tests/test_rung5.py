@@ -8,26 +8,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gsc_tpu.config.schema import (
-    AgentConfig,
-    EnvLimits,
-    ServiceConfig,
-    ServiceFunction,
-    SimConfig,
-)
+from gsc_tpu.config.catalog import mixed_service
+from gsc_tpu.config.schema import AgentConfig, EnvLimits, SimConfig
 from gsc_tpu.env.env import ServiceCoordEnv
 from gsc_tpu.sim import SimEngine, generate_traffic
 from gsc_tpu.topology.compiler import compile_topology
 from gsc_tpu.topology.synthetic import random_network
 from gsc_tpu.utils.debug import assert_invariants
-
-
-def mixed_service() -> ServiceConfig:
-    """Two chains over a shared SF pool: abc (3 x 5 ms) + de (8 ms + 2 ms).
-    Single source of truth lives next to the benchmark that measures it."""
-    from bench import mixed_service as _ms
-
-    return _ms()
 
 
 def test_mixed_sfc_catalog_engine():
