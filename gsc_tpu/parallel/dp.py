@@ -19,7 +19,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..agents.buffer import ReplayBuffer, buffer_add
+from ..agents.buffer import (ReplayBuffer, buffer_add, flatten_transition,
+                             restore_batch, transition_shapes)
 from ..agents.ddpg import DDPG, DDPGState
 from ..config.schema import AgentConfig
 from ..env.actions import action_mask
@@ -60,9 +61,11 @@ class ParallelDDPG:
         example = self.ddpg.example_transition(sample_obs)
         data = jax.tree_util.tree_map(
             lambda x: jnp.zeros((self.B, cap) + jnp.shape(x),
-                                jnp.asarray(x).dtype), example)
+                                jnp.asarray(x).dtype),
+            flatten_transition(example))
         return ReplayBuffer(data=data, pos=jnp.zeros(self.B, jnp.int32),
-                            size=jnp.zeros(self.B, jnp.int32))
+                            size=jnp.zeros(self.B, jnp.int32),
+                            shapes=transition_shapes(example))
 
     @partial(jax.jit, static_argnums=0)
     def reset_all(self, rng, topo, traffic):
@@ -149,7 +152,8 @@ class ParallelDDPG:
         bidx = jax.random.randint(kb, (self.agent.batch_size,), 0, self.B)
         sidx = jax.random.randint(ks, (self.agent.batch_size,), 0,
                                   jnp.maximum(buffers.size[bidx], 1))
-        return jax.tree_util.tree_map(lambda d: d[bidx, sidx], buffers.data)
+        raw = jax.tree_util.tree_map(lambda d: d[bidx, sidx], buffers.data)
+        return restore_batch(buffers.shapes, raw)
 
     def _sample_local(self, buffers: ReplayBuffer, key):
         """Shard-local stratified batch: batch_size/B (>=1) transitions from
@@ -166,8 +170,9 @@ class ParallelDDPG:
             return jax.tree_util.tree_map(lambda d: d[idx], shard)
 
         batch = jax.vmap(pick)(buffers.data, buffers.size, keys)
-        return jax.tree_util.tree_map(
+        raw = jax.tree_util.tree_map(
             lambda d: d.reshape((self.B * b_per,) + d.shape[2:]), batch)
+        return restore_batch(buffers.shapes, raw)
 
     @partial(jax.jit, static_argnums=0)
     def learn_burst(self, state: DDPGState, buffers: ReplayBuffer

@@ -343,7 +343,9 @@ class SimEngine:
         rng, k_proc = jax.random.split(state.rng)
 
         # --- 1. capacity releases ------------------------------------------
-        node_load = jnp.maximum(state.node_load - state.rel_node[ridx], 0.0)
+        node_load = jnp.maximum(
+            state.node_load - state.rel_node[ridx].reshape(self.N, self.P),
+            0.0)
         edge_used = jnp.maximum(state.edge_used - state.rel_edge[ridx], 0.0)
         rel_node = state.rel_node.at[ridx].set(0.0)
         rel_edge = state.rel_edge.at[ridx].set(0.0)
@@ -722,10 +724,11 @@ class SimEngine:
         oh_off_n = _onehot(jnp.where(rel_who, jnp.mod(ridx + off_n, self.H),
                                      self.H), self.H)          # [M, H]
         rel_vals = jnp.where(rel_who, dr, 0.0)
-        rel_node = rel_node + jnp.einsum(
-            "mh,mnp->hnp", oh_off_n,
-            jnp.einsum("mn,mp->mnp", oh_node * rel_vals[:, None], oh_sf,
-                       precision=_HI), precision=_HI)
+        np_flat = jnp.einsum("mn,mp->mnp", oh_node * rel_vals[:, None],
+                             oh_sf, precision=_HI
+                             ).reshape(self.M, self.N * self.P)
+        rel_node = rel_node + jnp.einsum("mh,mk->hk", oh_off_n, np_flat,
+                                         precision=_HI)
 
         # --- 7. departures & drops -----------------------------------------
         depart = depart_hop | depart_stay
