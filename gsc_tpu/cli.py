@@ -284,8 +284,10 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
             checkpoint, example,
             example_buffer=trainer.ddpg.init_buffer(obs),
             example_extra={"episode": _np.asarray(0, _np.int32)})["state"]
-    except (ValueError, KeyError):  # state-only checkpoint
-        state = load_checkpoint(checkpoint, example)["state"]
+    except (ValueError, KeyError):
+        # state-only checkpoint, or a full checkpoint whose replay storage
+        # format predates the current code: pull just the learner state
+        state = load_checkpoint(checkpoint, example, partial=True)["state"]
     out = trainer.evaluate(state, episodes=episodes, test_mode=True)
     click.echo(json.dumps(out))
 

@@ -37,13 +37,27 @@ def save_checkpoint(path: str, state: DDPGState,
 
 def load_checkpoint(path: str, example_state: DDPGState,
                     example_buffer: Optional[ReplayBuffer] = None,
-                    example_extra: Optional[dict] = None) -> dict:
-    """Restore a checkpoint into the shapes/dtypes of the given examples."""
+                    example_extra: Optional[dict] = None,
+                    partial: bool = False) -> dict:
+    """Restore a checkpoint into the shapes/dtypes of the given examples.
+
+    ``partial=True`` restores only the keys present in the target and
+    ignores extra on-disk entries — e.g. pulling just the learner state
+    out of a full train checkpoint whose replay-buffer storage format
+    differs from the current code's."""
     path = os.path.abspath(path)
     target = {"state": example_state}
     if example_buffer is not None:
         target["buffer"] = example_buffer
     if example_extra is not None:
         target["extra"] = example_extra
+    if partial:
+        ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                item=target,
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    target),
+                partial_restore=True))
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, target)

@@ -319,3 +319,24 @@ def test_learning_makes_optimization_progress():
     assert np.mean(losses[-3:]) < 0.5 * np.mean(losses[:3]), losses
     assert qs[-1] > qs[0], qs
     assert all(np.isfinite(losses))
+
+
+def test_partial_restore_pulls_state_from_full_checkpoint(tmp_path):
+    """load_checkpoint(partial=True) extracts just the learner state from
+    a full train checkpoint (state + replay + extra), including ones whose
+    replay storage format no longer matches the current code — the
+    cli-infer fallback path."""
+    from gsc_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    env, agent, topo, traffic = make_stack()
+    ddpg = DDPG(env, agent)
+    _, obs = env.reset(jax.random.PRNGKey(0), topo, traffic)
+    state = ddpg.init(jax.random.PRNGKey(3), obs)
+    buf = buffer_init(ddpg.example_transition(obs), capacity=4)
+    path = save_checkpoint(str(tmp_path / "full"), state, buffer=buf,
+                           extra={"episode": np.asarray(7, np.int32)})
+    # target omits buffer/extra entirely -> strict restore would raise
+    restored = load_checkpoint(path, state, partial=True)["state"]
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, restored.actor_params,
+        state.actor_params)
