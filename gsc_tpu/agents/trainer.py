@@ -142,6 +142,15 @@ class Trainer:
                 state, learn_metrics = self.ddpg.learn_burst(state, buffer)
             sps = ((ep - start_episode + 1) * steps_per_ep
                    / (time.time() - start))
+            trunc = int(np.asarray(env_state.sim.truncated_arrivals))
+            if trunc > 0:
+                # overload: the flow table (or per-substep arrival budget)
+                # saturated, so some arrivals spawned late — generated-flow
+                # timing no longer matches the reference's unbounded model
+                log.warning(
+                    "episode=%d: %d arrivals admitted late (flow-table "
+                    "slot exhaustion) — raise SimConfig.max_flows to "
+                    "restore exact arrival timing", ep, trunc)
             self._log(ep, end_step, stats, learn_metrics, sps)
             if verbose:
                 # per-episode progress line (the reference's tqdm + SPS
@@ -206,7 +215,9 @@ class Trainer:
                         episode=ep, time=float(env_state.sim.t),
                         metrics=env_state.sim.metrics, placement=placement,
                         node_cap=traffic.node_cap[max(idx, 0)],
-                        schedule=sched, runtime=runtime, rl_state=flat)
+                        schedule=sched, runtime=runtime, rl_state=flat,
+                        truncated_arrivals=int(np.asarray(
+                            env_state.sim.truncated_arrivals)))
             totals.append(ep_reward)
             succ.append(float(np.asarray(infos["succ_ratio"])))
         if writer:

@@ -225,12 +225,28 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         if resume:
             topo0, traffic0 = driver.episode(0, False)
             _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
-            restored = load_checkpoint(
-                resume, trainer.ddpg.init(jax.random.PRNGKey(0), obs0),
-                example_buffer=trainer.ddpg.init_buffer(obs0),
-                example_extra={"episode": _np.asarray(0, _np.int32)})
+            example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
+            try:
+                restored = load_checkpoint(
+                    resume, example,
+                    example_buffer=trainer.ddpg.init_buffer(obs0),
+                    example_extra={"episode": _np.asarray(0, _np.int32)})
+                init_buffer = restored["buffer"]
+            except (ValueError, KeyError):
+                # checkpoint whose replay storage format predates the
+                # current code (leaves were stored unflattened): restore
+                # learner state + episode counter, start with empty replay
+                restored = load_checkpoint(
+                    resume, example,
+                    example_extra={"episode": _np.asarray(0, _np.int32)},
+                    partial=True)
+                init_buffer = None
+                click.echo("[resume] replay buffer not restorable (legacy "
+                           "storage format, or replay config such as "
+                           "mem_limit changed since the checkpoint) — "
+                           "restored state only, replay starts empty",
+                           err=True)
             init_state = restored["state"]
-            init_buffer = restored["buffer"]
             start_episode = int(restored["extra"]["episode"])
         result.runtime_start("train")
         state, buffer = trainer.train(episodes, verbose=verbose,

@@ -65,6 +65,14 @@ def check_invariants(state: SimState, topo: Topology,
             f"table={int(active.sum())}")
     if int(m.dropped) != int(np.asarray(m.drop_reasons).sum()):
         errs.append("drop_reasons do not sum to dropped")
+    # WRR realized-ratio counters round-trip through f32 one-hot dots every
+    # decision round (engine._take); exactness requires every count to stay
+    # below 2^24 (f32 integer-exact range).  run_flow_counts is the only
+    # unbounded integer routed through them — per-run resets keep it tiny
+    # today, but a cadence change would corrupt silently without this.
+    if int(np.asarray(m.run_flow_counts).max()) >= 2 ** 24:
+        errs.append("run_flow_counts >= 2^24 (f32 one-hot dots lose "
+                    "integer exactness)")
     trunc = int(np.asarray(state.truncated_arrivals))
     if trunc > 0:
         # not state corruption, but a visible divergence from the

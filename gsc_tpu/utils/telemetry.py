@@ -44,9 +44,13 @@ class TestModeWriter:
         w("placements.csv", ["episode", "time", "node", "sf"])
         w("node_metrics.csv", ["episode", "time", "node", "node_capacity",
                                "used_resources", "ingress_traffic"])
+        # trailing truncated_arrivals column is an extension over the
+        # reference schema (writer.py:47): nonzero means flow-table slot
+        # exhaustion / the per-substep arrival budget delayed arrivals and
+        # generated-flow timing no longer matches the reference exactly
         w("metrics.csv", ["episode", "time", "total_flows", "successful_flows",
                           "dropped_flows", "in_network_flows",
-                          "avg_end2end_delay"])
+                          "avg_end2end_delay", "truncated_arrivals"])
         w("run_flows.csv", ["episode", "time", "successful_flows",
                             "dropped_flows", "total_flows"])
         w("runtimes.csv", ["run", "runtime"])
@@ -81,7 +85,8 @@ class TestModeWriter:
     def write_step(self, episode: int, time: float, metrics, placement,
                    node_cap, node_names: Optional[Sequence[str]] = None,
                    schedule=None, runtime: Optional[float] = None,
-                   rl_state: Optional[Sequence[float]] = None):
+                   rl_state: Optional[Sequence[float]] = None,
+                   truncated_arrivals: int = 0):
         """Log one control interval from device pytrees."""
         placement = np.asarray(placement)
         node_cap = np.asarray(node_cap)
@@ -109,7 +114,7 @@ class TestModeWriter:
         self._writers["metrics.csv"].writerow(
             [episode, time, int(metrics.generated), int(metrics.processed),
              int(metrics.dropped), int(metrics.active),
-             float(metrics.avg_e2e())])
+             float(metrics.avg_e2e()), int(truncated_arrivals)])
         self._writers["run_flows.csv"].writerow(
             [episode, time, int(metrics.run_processed),
              int(metrics.run_dropped), int(metrics.run_generated)])

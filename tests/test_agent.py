@@ -37,7 +37,7 @@ def line_topo():
     return compile_topology(spec, max_nodes=N, max_edges=E)
 
 
-def make_stack(episode_steps=4, warmup=4, graph_mode=True):
+def make_stack(episode_steps=4, warmup=4, graph_mode=True, sim_kwargs=None):
     service = make_service()
     limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
     agent = AgentConfig(
@@ -46,7 +46,7 @@ def make_stack(episode_steps=4, warmup=4, graph_mode=True):
         gnn_features=8, actor_hidden_layer_nodes=(16,),
         critic_hidden_layer_nodes=(16,), mem_limit=64, batch_size=4,
         objective="prio-flow")
-    cfg = SimConfig(ttl_choices=(100.0,))
+    cfg = SimConfig(ttl_choices=(100.0,), **(sim_kwargs or {}))
     env = ServiceCoordEnv(service, cfg, agent, limits)
     topo = line_topo()
     traffic = generate_traffic(cfg, service, topo, episode_steps + 2, seed=0)
@@ -264,6 +264,30 @@ def test_cli_train_resume_roundtrip(tmp_path):
                                         "--max-nodes", "8",
                                         "--max-edges", "8"])
     assert r3.exit_code == 0, (r3.output, r3.exception)
+
+    # --resume from a checkpoint WITHOUT a restorable replay buffer (the
+    # shape a pre-r3 storage-format checkpoint presents): falls back to a
+    # partial restore — learner state + episode counter, empty replay —
+    # instead of failing the strict orbax restore (ADVICE r3)
+    from gsc_tpu.cli import _build
+    from gsc_tpu.utils.checkpoint import save_checkpoint
+
+    env, driver, _agent = _build(*[str(cfg / f) for f in
+                                   ("agent.yaml", "sim.yaml", "svc.yaml",
+                                    "sched.yaml")], 0, 8, 8)
+    from gsc_tpu.agents.trainer import Trainer as _Trainer
+    tr = _Trainer(env, driver, _agent, seed=0)
+    topo0, traffic0 = driver.episode(0, False)
+    _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
+    state_only = tr.ddpg.init(jax.random.PRNGKey(0), obs0)
+    np_int = np.asarray(2, np.int32)
+    so_path = save_checkpoint(str(cfg / "ckpt_state_only"), state_only,
+                              extra={"episode": np_int})
+    r4 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "4",
+                                        "--result-dir", str(cfg / "res3"),
+                                        "--resume", so_path])
+    assert r4.exit_code == 0, (r4.output, r4.exception)
+    assert "replay buffer not restorable" in r4.output
 
 
 def test_logging_setup(tmp_path):

@@ -93,12 +93,37 @@ ORACLE = {
         "limits": (64, 96),
         "exact": True,
     },
+    # line3-linkcap2 (repo asset, absolute paths): LinkFwdCap=2 line with
+    # huge node caps, fast arrivals, 20 ms flow durations — the only
+    # oracle whose drops are LINK_CAP, pinning the link-admission
+    # comparison ordering (engine.py stage 5: prefix <= cap-used headroom
+    # vs the reference's used+prefix <= cap; ADVICE r3 flagged that no
+    # oracle would catch an admission flip at exact capacity ties).
+    "linkcap": {
+        "network": os.path.join(REPO, "tests", "assets",
+                                "line3-linkcap2.graphml"),
+        "config": os.path.join(REPO, "tests", "assets",
+                               "linkcap_config.yaml"),
+        "generated": 2500, "processed": 151, "dropped": 2348,
+        "drop_reasons": {"TTL": 0, "DECISION": 0, "LINK_CAP": 2348,
+                         "NODE_CAP": 0},
+        "avg_e2e": 22.94701986754967,
+        # saturated links make nearly every substep a same-timestamp
+        # admission tie, resolved slot-order here vs SimPy-FIFO there
+        # (documented divergence, engine.py module docstring) — counts
+        # drift ~1% (engine: 169/2329) but a broken admission comparison
+        # (e.g. off-by-one-flow headroom) would shift them by >10x this
+        # tolerance, and every drop must still be LINK_CAP.
+        "atol_flows": 30,
+        "e2e_rel": 0.05,
+    },
 }
 STEPS = 50
 SEED = 1234
 
 
-def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37):
+def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37,
+                config=CONFIG):
     """The cli-simulate path, in-process: uniform schedule over real nodes,
     everything placed everywhere, 50 x 100 ms control intervals."""
     from gsc_tpu.config.loader import load_service, load_sim
@@ -108,7 +133,7 @@ def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37):
     from gsc_tpu.topology.compiler import load_topology
 
     svc = load_service(os.path.join(REFERENCE, SERVICE))
-    sim_cfg = load_sim(os.path.join(REFERENCE, CONFIG), **(overrides or {}))
+    sim_cfg = load_sim(os.path.join(REFERENCE, config), **(overrides or {}))
     limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
                                    max_edges=max_edges)
     topo = load_topology(os.path.join(REFERENCE, network_rel),
@@ -139,17 +164,29 @@ def test_engine_matches_reference(name):
     want = ORACLE[name]
     mn, me = want.get("limits", (24, 37))
     got = _run_engine(want["network"], want.get("overrides"),
-                      max_nodes=mn, max_edges=me)
+                      max_nodes=mn, max_edges=me,
+                      config=want.get("config", CONFIG))
     assert got["generated"] == want["generated"]
     if want.get("exact"):
         assert got["processed"] == want["processed"], (got, want)
         assert got["dropped"] == want["dropped"], (got, want)
         assert got["avg_e2e"] == pytest.approx(want["avg_e2e"], rel=1e-5)
+        assert got["drop_reasons"] == want["drop_reasons"]
+    elif "atol_flows" in want:
+        atol = want["atol_flows"]
+        assert abs(got["processed"] - want["processed"]) <= atol, (got, want)
+        assert abs(got["dropped"] - want["dropped"]) <= atol, (got, want)
+        assert got["avg_e2e"] == pytest.approx(want["avg_e2e"],
+                                               rel=want["e2e_rel"])
+        for reason, n in want["drop_reasons"].items():
+            assert abs(got["drop_reasons"][reason] - n) <= atol, (got, want)
+            if n == 0:  # no misclassification: unused reasons stay at zero
+                assert got["drop_reasons"][reason] == 0, (got, want)
     else:
         assert abs(got["processed"] - want["processed"]) <= 2, (got, want)
         assert abs(got["dropped"] - want["dropped"]) <= 2, (got, want)
         assert got["avg_e2e"] == pytest.approx(want["avg_e2e"], rel=0.025)
-    assert got["drop_reasons"] == want["drop_reasons"]
+        assert got["drop_reasons"] == want["drop_reasons"]
 
 
 @pytest.mark.parametrize("name", sorted(ORACLE.keys()))
@@ -161,6 +198,7 @@ def test_oracle_numbers_are_current(name):
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "run_reference.py"),
          "--mode", "interface", "--network", want["network"],
+         "--config", want.get("config", CONFIG),
          "--steps", str(STEPS), "--seed", str(SEED)],
         capture_output=True, text=True, timeout=600, env=env)
     assert r.returncode == 0, r.stderr[-2000:]

@@ -44,7 +44,9 @@ def test_telemetry_csv_suite(tmp_path):
         rows = list(csv.reader(f))
     assert rows[0] == ["episode", "time", "total_flows", "successful_flows",
                        "dropped_flows", "in_network_flows",
-                       "avg_end2end_delay"]
+                       "avg_end2end_delay", "truncated_arrivals"]
+    # healthy run: no arrival ever delayed by slot exhaustion
+    assert all(int(r[7]) == 0 for r in rows[1:])
     assert len(rows) == 1 + trainer.agent_cfg.episode_steps
     with open(tdir / "drop_reasons.csv") as f:
         assert next(csv.reader(f)) == ["episode", "time", "TTL", "DECISION",
@@ -57,6 +59,23 @@ def test_telemetry_csv_suite(tmp_path):
         rows = list(csv.reader(f))
     assert rows[0] == ["run", "runtime"]
     assert float(rows[1][1]) > 0
+
+
+def test_overload_surfaces_truncated_arrivals(tmp_path, caplog):
+    """A flow table far smaller than the offered load must WARN during
+    training and export a nonzero truncated_arrivals column — overload can
+    no longer mis-measure generated-flow timing silently (VERDICT r3)."""
+    import logging
+
+    trainer = make_trainer(
+        tmp_path, sim_kwargs={"max_flows": 4, "inter_arrival_mean": 1.0})
+    with caplog.at_level(logging.WARNING, logger="gsc_tpu.agents.trainer"):
+        state, _ = trainer.train(episodes=1)
+    assert any("admitted late" in r.message for r in caplog.records)
+    trainer.evaluate(state, episodes=1, telemetry=True)
+    with open(tmp_path / "test" / "metrics.csv") as f:
+        rows = list(csv.reader(f))
+    assert int(rows[-1][7]) > 0
 
 
 def test_checkpoint_roundtrip(tmp_path):
