@@ -223,23 +223,17 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         init_state = init_buffer = None
         start_episode = 0
         if resume:
+            from .utils.checkpoint import load_full_or_partial
             topo0, traffic0 = driver.episode(0, False)
             _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
             example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
-            try:
-                restored = load_checkpoint(
-                    resume, example,
-                    example_buffer=trainer.ddpg.init_buffer(obs0),
-                    example_extra={"episode": _np.asarray(0, _np.int32)})
+            example_buffer = trainer.ddpg.init_buffer(obs0)
+            restored, buffer_ok = load_full_or_partial(
+                resume, example, example_buffer=example_buffer,
+                example_extra={"episode": _np.asarray(0, _np.int32)})
+            if buffer_ok:
                 init_buffer = restored["buffer"]
-            except (ValueError, KeyError):
-                # checkpoint whose replay storage format predates the
-                # current code (leaves were stored unflattened): restore
-                # learner state + episode counter, start with empty replay
-                restored = load_checkpoint(
-                    resume, example,
-                    example_extra={"episode": _np.asarray(0, _np.int32)},
-                    partial=True)
+            else:
                 init_buffer = None
                 click.echo("[resume] replay buffer not restorable (legacy "
                            "storage format, or replay config such as "
@@ -247,7 +241,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                            "restored state only, replay starts empty",
                            err=True)
             init_state = restored["state"]
-            start_episode = int(restored["extra"]["episode"])
+            start_episode = int(restored["extra"]["episode"]) \
+                if "extra" in restored else 0
         result.runtime_start("train")
         state, buffer = trainer.train(episodes, verbose=verbose,
                                       profile=profile, init_state=init_state,
@@ -285,7 +280,7 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
     """Restore a checkpoint and run greedy test episodes
     (inference.py:17-40)."""
     from .agents.trainer import Trainer
-    from .utils.checkpoint import load_checkpoint
+    from .utils.checkpoint import load_full_or_partial
 
     import numpy as _np
 
@@ -295,15 +290,12 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
     topo, traffic = driver.episode(0, test_mode=True)
     _, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)
     example = trainer.ddpg.init(jax.random.PRNGKey(0), obs)
-    try:  # full train checkpoint (state + replay + episode counter)
-        state = load_checkpoint(
-            checkpoint, example,
-            example_buffer=trainer.ddpg.init_buffer(obs),
-            example_extra={"episode": _np.asarray(0, _np.int32)})["state"]
-    except (ValueError, KeyError):
-        # state-only checkpoint, or a full checkpoint whose replay storage
-        # format predates the current code: pull just the learner state
-        state = load_checkpoint(checkpoint, example, partial=True)["state"]
+    example_buffer = trainer.ddpg.init_buffer(obs)
+    # full train checkpoint (state + replay + episode counter), or a
+    # state-only / legacy-replay-format checkpoint via partial restore
+    state = load_full_or_partial(
+        checkpoint, example, example_buffer=example_buffer,
+        example_extra={"episode": _np.asarray(0, _np.int32)})[0]["state"]
     out = trainer.evaluate(state, episodes=episodes, test_mode=True)
     click.echo(json.dumps(out))
 

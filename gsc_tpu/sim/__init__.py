@@ -12,6 +12,7 @@ from .state import (  # noqa: F401
 )
 from .engine import ServiceTables, SimEngine  # noqa: F401
 from .traffic import TraceEvents, generate_traffic, traffic_capacity  # noqa: F401
+from .traffic_device import DeviceTraffic  # noqa: F401
 from .perflow import PendingFlows, PerFlowController  # noqa: F401
 from .dummy import DummyEngine  # noqa: F401
 from .predictor import (  # noqa: F401

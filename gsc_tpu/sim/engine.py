@@ -378,14 +378,12 @@ class SimEngine:
             num_path_delay=m.num_path_delay + n_arr,
             run_path_delay_sum=m.run_path_delay_sum + path_add,
         )
-        # same masked lookup as stage 4: an out-of-range SFC id must read
-        # chain_len = 0 here too, else a corrupt-sfc flow that was routed
-        # to egress would re-enter processing as a chain-(C-1) flow on
-        # arrival (the two lookups have to agree on the flow's chain)
+        # un-clipped one-hot: an out-of-range SFC id gives an all-zero row
+        # (chain_len = 0), so a corrupt-sfc flow heads to egress instead of
+        # being silently attributed to chain C-1; stage 4 reads chain_len
+        # the same way so the two lookups agree on the flow's chain
         chain_len = _take(jnp.asarray(self.tables.chain_len),
-                          _onehot(jnp.clip(F.sfc, 0, self.C - 1), self.C)
-                          * ((F.sfc >= 0) & (F.sfc < self.C))[:, None]
-                          .astype(jnp.float32))
+                          _onehot(F.sfc, self.C))
         to_eg_flag = position >= chain_len             # forward_to_eg
         depart_hop = arrived & to_eg_flag              # reached egress: success
         need_proc_a = arrived & ~to_eg_flag
@@ -449,14 +447,13 @@ class SimEngine:
             ].add(jnp.where(spawn, traffic.arr_dr[cand_c], 0.0), mode="drop"),
         )
 
-        # recompute flags after arrivals.  Out-of-range SFC ids (reachable
-        # only with corrupt traffic data) are MASKED, not clamped: a clamp
-        # would silently attribute them to chain C-1 in run_requested /
-        # flow_counts.  A zeroed one-hot row reads chain_len = 0, so such a
-        # flow takes the to-egress path and never touches the WRR tables.
-        sfc_ok = (sfc >= 0) & (sfc < self.C)
+        # recompute flags after arrivals.  The UN-clipped one-hot zero-rows
+        # out-of-range SFC ids (reachable only with corrupt traffic data):
+        # chain_len reads 0, so such a flow takes the to-egress path and
+        # never reaches the WRR tables — a clamp would instead silently
+        # attribute it to chain C-1 in run_requested / flow_counts.
         sfc_c = jnp.clip(sfc, 0, self.C - 1)
-        oh_sfc = _onehot(sfc_c, self.C) * sfc_ok[:, None].astype(jnp.float32)
+        oh_sfc = _onehot(sfc, self.C)
         chain_len = _take(jnp.asarray(self.tables.chain_len), oh_sfc)
         to_eg_flag = position >= chain_len
 

@@ -61,3 +61,32 @@ def load_checkpoint(path: str, example_state: DDPGState,
                 partial_restore=True))
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, target)
+
+
+def load_full_or_partial(path: str, example_state: DDPGState,
+                         example_buffer: Optional[ReplayBuffer] = None,
+                         example_extra: Optional[dict] = None
+                         ) -> tuple[dict, bool]:
+    """Full restore, falling back to a buffer-less partial restore when the
+    on-disk replay doesn't match ``example_buffer`` (legacy storage format,
+    or replay config such as mem_limit changed since the checkpoint).
+
+    Returns ``(restored, buffer_restored)``.  Only the restore itself is
+    guarded — build the examples BEFORE calling so unrelated construction
+    errors surface instead of being misread as a format mismatch."""
+    try:
+        return load_checkpoint(path, example_state,
+                               example_buffer=example_buffer,
+                               example_extra=example_extra), True
+    except (ValueError, KeyError):
+        pass
+    try:
+        return load_checkpoint(path, example_state,
+                               example_extra=example_extra,
+                               partial=True), False
+    except (ValueError, KeyError):
+        if example_extra is None:
+            raise
+        # state-only checkpoint without metadata (e.g. a bare actor
+        # export): the caller gets no "extra" key and must default
+        return load_checkpoint(path, example_state, partial=True), False

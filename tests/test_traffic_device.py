@@ -1,0 +1,135 @@
+"""On-device traffic generator tests: bitwise parity with the host
+generator on deterministic configs, distributional parity on stochastic
+ones, trace/MMPP semantics, and engine compatibility."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gsc_tpu.config.schema import EnvLimits, MMPPState, SimConfig
+from gsc_tpu.sim.engine import SimEngine
+from gsc_tpu.sim.traffic import TraceEvents, generate_traffic
+from gsc_tpu.sim.traffic_device import DeviceTraffic
+
+from tests.test_traffic import service, topo
+
+
+def test_deterministic_bitwise_matches_host():
+    """Fully deterministic config: the device sampler reproduces the host
+    schedule bit-for-bit (every random draw is degenerate, so the RNG
+    difference is invisible)."""
+    cfg = SimConfig(ttl_choices=(100.0,), inter_arrival_mean=10.0)
+    host = generate_traffic(cfg, service(), topo(2), episode_steps=5, seed=0)
+    dev = jax.jit(DeviceTraffic(cfg, service(), topo(2), 5).sample)(
+        jax.random.PRNGKey(0))
+    for field in ("arr_time", "arr_ingress", "arr_dr", "arr_duration",
+                  "arr_ttl", "ingress_active", "node_cap"):
+        np.testing.assert_array_equal(np.asarray(getattr(host, field)),
+                                      np.asarray(getattr(dev, field)),
+                                      err_msg=field)
+
+
+def test_poisson_rates_match_host_distribution():
+    cfg = SimConfig(ttl_choices=(100.0,), deterministic_arrival=False,
+                    inter_arrival_mean=10.0)
+    dt = DeviceTraffic(cfg, service(), topo(1), episode_steps=20)
+    sample = jax.jit(dt.sample)
+    counts, gaps = [], []
+    for s in range(8):
+        tr = sample(jax.random.PRNGKey(s))
+        t = np.asarray(tr.arr_time)
+        t = t[np.isfinite(t)]
+        counts.append(len(t))
+        gaps.append(np.diff(np.sort(t)))
+    # horizon/mean = 200 expected arrivals; 8 seeds of Poisson(200)
+    assert abs(np.mean(counts) - 200) < 25
+    assert abs(np.concatenate(gaps).mean() - 10.0) < 1.5
+    # distinct seeds -> distinct streams
+    assert counts[0] != counts[1] or not np.array_equal(gaps[0], gaps[1])
+
+
+def test_pareto_sizes_and_dr_rejection():
+    cfg = SimConfig(ttl_choices=(100.0,), deterministic_size=False,
+                    flow_size_shape=2.0, flow_dr_mean=1.0, flow_dr_stdev=0.3)
+    dt = DeviceTraffic(cfg, service(), topo(1), episode_steps=10)
+    tr = jax.jit(dt.sample)(jax.random.PRNGKey(0))
+    fin = np.isfinite(np.asarray(tr.arr_time))
+    dr = np.asarray(tr.arr_dr)[fin]
+    dur = np.asarray(tr.arr_duration)[fin]
+    assert (dr >= 0).all()                      # rejection semantics
+    sizes = dur * dr / 1000.0
+    assert (sizes >= 1.0 - 1e-5).all()          # Pareto support
+    # Pareto(2) mean is 2; loose check over ~100 draws
+    assert 1.3 < sizes.mean() < 3.5
+
+
+def test_mmpp_density_and_interval_means():
+    cfg = SimConfig(
+        ttl_choices=(100.0,), deterministic_arrival=True,
+        use_states=True, init_state="s0", rand_init_state=False,
+        states=(MMPPState(name="s0", inter_arr_mean=5.0, switch_p=0.5),
+                MMPPState(name="s1", inter_arr_mean=50.0, switch_p=0.5)))
+    dt = DeviceTraffic(cfg, service(), topo(1), episode_steps=40)
+    tr = jax.jit(dt.sample)(jax.random.PRNGKey(7))
+    t = np.asarray(tr.arr_time)
+    t = t[np.isfinite(t)]
+    counts = np.histogram(t, bins=40, range=(0, 4000))[0]
+    # both dense (~20/interval) and sparse (~2/interval) states visited
+    assert counts.max() >= 15 and counts.min() <= 3
+    # the chain is per-episode randomness: two keys give different paths
+    tr2 = jax.jit(dt.sample)(jax.random.PRNGKey(8))
+    t2 = np.asarray(tr2.arr_time)
+    assert not np.array_equal(t, t2[np.isfinite(t2)])
+
+
+def test_trace_deactivation_and_caps():
+    """Trace rows deactivate/reactivate an ingress and raise node caps
+    exactly like the host generator (trace_processor.py:23-54)."""
+    rows = [(200.0, 0, None, None), (400.0, 0, 10.0, 5000.0)]
+    cfg = SimConfig(ttl_choices=(100.0,), inter_arrival_mean=10.0)
+    trace = TraceEvents(rows)
+    host = generate_traffic(cfg, service(), topo(1), 6, seed=0, trace=trace)
+    dev = jax.jit(DeviceTraffic(cfg, service(), topo(1), 6,
+                                trace=trace).sample)(jax.random.PRNGKey(0))
+    for field in ("arr_time", "arr_ingress", "ingress_active", "node_cap"):
+        np.testing.assert_array_equal(np.asarray(getattr(host, field)),
+                                      np.asarray(getattr(dev, field)),
+                                      err_msg=field)
+    t = np.asarray(dev.arr_time)
+    t = t[np.isfinite(t)]
+    assert not ((t >= 200.0) & (t < 400.0)).any()   # silent window
+    assert (t >= 400.0).any()                        # reactivated
+    assert np.asarray(dev.node_cap)[4:, 0].max() == 5000.0
+
+
+def test_engine_consumes_device_traffic():
+    """The sim engine runs on a device-sampled schedule and books flows."""
+    cfg = SimConfig(ttl_choices=(100.0,), inter_arrival_mean=10.0,
+                    max_flows=32)
+    svc = service()
+    limits = EnvLimits(max_nodes=8, max_edges=8, num_sfcs=1, max_sfs=2)
+    tp = topo(2)
+    dt = DeviceTraffic(cfg, svc, tp, episode_steps=3)
+    traffic = jax.jit(dt.sample)(jax.random.PRNGKey(0))
+    engine = SimEngine(svc, cfg, limits)
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    nm = np.asarray(tp.node_mask)
+    sched[:, :, :, nm] = 1.0 / nm.sum()
+    placement = jnp.asarray(np.broadcast_to(nm[:, None], (8, 2)).copy())
+    state = engine.init(jax.random.PRNGKey(0), tp)
+    for _ in range(3):
+        state, metrics = engine.apply(state, tp, traffic,
+                                      jnp.asarray(sched), placement)
+    assert int(metrics.generated) > 0
+    assert int(metrics.generated) == (int(metrics.processed)
+                                      + int(metrics.dropped)
+                                      + int(metrics.active))
+
+
+def test_batch_sampling_shapes_and_divergence():
+    cfg = SimConfig(ttl_choices=(100.0,), deterministic_arrival=False)
+    dt = DeviceTraffic(cfg, service(), topo(2), episode_steps=4)
+    b = jax.jit(lambda k: dt.sample_batch(k, 4))(jax.random.PRNGKey(0))
+    assert b.arr_time.shape == (4, dt.capacity)
+    assert b.ingress_active.shape == (4, 4, 8)
+    t = np.asarray(b.arr_time)
+    assert not np.array_equal(t[0], t[1])       # per-replica streams

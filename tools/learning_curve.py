@@ -32,6 +32,10 @@ def main():
     ap.add_argument("--chunk", type=int, default=50)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--host-traffic", action="store_true",
+                    help="per-episode traffic on the HOST (the r3 path; "
+                    "ships ~90 MB/episode at B=256 through the device "
+                    "tunnel).  Default is on-device sampling.")
     args = ap.parse_args()
 
     import jax
@@ -42,13 +46,26 @@ def main():
     from __graft_entry__ import _flagship
     from gsc_tpu.parallel import ParallelDDPG
     from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.sim.traffic_device import DeviceTraffic
 
     T, B, chunk = args.episode_steps, args.replicas, args.chunk
     assert T % chunk == 0
     env, agent, topo, _ = _flagship(episode_steps=T)
-    traffic0 = [generate_traffic(env.sim_cfg, env.service, topo, T, seed=s)
-                for s in range(B)]
-    traffic = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *traffic0)
+
+    if args.host_traffic:
+        def episode_traffic(ep):
+            t0 = [generate_traffic(env.sim_cfg, env.service, topo, T,
+                                   seed=1000 * ep + s) for s in range(B)]
+            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *t0)
+    else:
+        dt = DeviceTraffic(env.sim_cfg, env.service, topo, T)
+        sample_batch = jax.jit(lambda k: dt.sample_batch(k, B))
+
+        def episode_traffic(ep):
+            return sample_batch(jax.random.fold_in(
+                jax.random.PRNGKey(args.seed + 3), ep))
+
+    traffic = episode_traffic(0)
     pddpg = ParallelDDPG(env, agent, num_replicas=B)
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(args.seed), topo,
                                       traffic)
@@ -59,11 +76,11 @@ def main():
     returns, succ = [], []
     t0 = time.time()
     for ep in range(args.episodes):
-        # fresh per-episode traffic like the trainer (host resample)
-        traffic0 = [generate_traffic(env.sim_cfg, env.service, topo, T,
-                                     seed=1000 * ep + s) for s in range(B)]
-        traffic = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                         *traffic0)
+        # fresh per-episode traffic like the trainer (device resample by
+        # default: no host->device flow-tensor transfer between episodes);
+        # episode 0 reuses the pre-loop sample
+        if ep:
+            traffic = episode_traffic(ep)
         env_states, obs = pddpg.reset_all(
             jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), ep),
             topo, traffic)
