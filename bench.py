@@ -231,17 +231,16 @@ def _rung5_stack(episode_steps):
 
     service = mixed_service()
     limits = EnvLimits.for_service(service, max_nodes=256, max_edges=384)
-    # 393k floats per action/mask make the flagship hyperparameters
-    # unaffordable here: with actor hidden 256 the output layer alone is
-    # 100M params, and params+targets+Adam+grads+replay measured
-    # RESOURCE_EXHAUSTED in the learn burst even at B=4.  Scenario
-    # hyperparameters: smaller nets (25M-param actor head), 32-sample
-    # batches, replay of max(512 // B, 32) transitions per replica
-    # (64 at the measured B=8, the batch_size floor of 32 at B=16).
+    # FLAGSHIP architecture hyperparameters (default 256/64 hidden, batch
+    # 100): the factored action head auto-enables at this action dim
+    # (models/nets.py), so the r3 blocker — a 100M-param monolithic output
+    # matrix that OOMed the learn burst even at B=4 — no longer exists and
+    # the network config ports up the ladder unchanged.  Only the replay
+    # BUDGET stays scenario-sized: a rung-5 transition carries ~1.2M f32
+    # (two 393k masks + a 393k action), so the flagship's 10000-transition
+    # replay would be ~47 GB; 1024 transitions ~ 5 GB fits one chip.
     agent = AgentConfig(graph_mode=True, episode_steps=episode_steps,
-                        objective="prio-flow", mem_limit=512, batch_size=32,
-                        actor_hidden_layer_nodes=(64,),
-                        critic_hidden_layer_nodes=(32,))
+                        objective="prio-flow", mem_limit=1024)
     sim_cfg = SimConfig(ttl_choices=(100.0,), max_flows=1024)
     env = ServiceCoordEnv(service, sim_cfg, agent, limits)
     topo = compile_topology(random_network(200, num_ingress=8, seed=11),

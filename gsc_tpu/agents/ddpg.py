@@ -62,9 +62,12 @@ class DDPG:
         self.agent = agent
         self.action_dim = env.limits.action_dim
         gnn_impl = gnn_impl or agent.gnn_impl  # config-selected embedder
+        sched_shape = env.limits.scheduling_shape
         self.actor = Actor(agent=agent, action_dim=self.action_dim,
-                           gnn_impl=gnn_impl)
-        self.critic = QNetwork(agent=agent, gnn_impl=gnn_impl)
+                           gnn_impl=gnn_impl, sched_shape=sched_shape)
+        self.critic = QNetwork(agent=agent, gnn_impl=gnn_impl,
+                               action_dim=self.action_dim,
+                               sched_shape=sched_shape)
         self.opt = optax.adam(agent.learning_rate)
 
     # ---------------------------------------------------------------- init

@@ -220,6 +220,13 @@ class AgentConfig:
     gnn_impl: str = "dense"
     actor_hidden_layer_nodes: Tuple[int, ...] = (256,)
     critic_hidden_layer_nodes: Tuple[int, ...] = (64,)
+    # Factored (per-node bilinear) action head for large scheduling
+    # tensors.  None = automatic: enabled in graph mode when the action
+    # dim crosses models/nets.py:FACTORED_HEAD_THRESHOLD (the monolithic
+    # Dense output layer OOMs one chip near rung-5 padding).  New keys —
+    # the reference's monolithic head (models.py:97-153) has no analogue.
+    factored_head: Optional[bool] = None
+    factored_key_dim: int = 32
 
     # objective / reward (reference: gym_env.py:300-380)
     objective: str = "weighted"

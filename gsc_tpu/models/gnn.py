@@ -72,6 +72,8 @@ class GNNEmbedder(nn.Module):
     num_iter: int = 2
     mean_aggr: bool = True
     impl: str = "dense"
+    pool: bool = True   # False: return per-node features at the readout
+                        # point (factored action heads read node embeddings)
 
     @nn.compact
     def __call__(self, nodes, edge_index, edge_mask, node_mask):
@@ -82,10 +84,14 @@ class GNNEmbedder(nn.Module):
                   node_mask=node_mask)
         conv_args = dict(features=self.hidden, mean_aggr=self.mean_aggr,
                          impl=self.impl)
+
+        def readout(x):
+            return masked_mean_pool(x, node_mask) if self.pool else x
+
         x = GATv2Conv(**conv_args, name="encoder")(nodes, **kw)
         x = nn.relu(x)
         if self.num_layers == 1:
-            return masked_mean_pool(x, node_mask)
+            return readout(x)
         # instantiating each process conv once and calling it num_iter times
         # shares its parameters — the reference's weight tying (models.py:44-53)
         process = [GATv2Conv(**conv_args, name=f"process_{i}")
@@ -94,5 +100,5 @@ class GNNEmbedder(nn.Module):
             for i, conv in enumerate(process):
                 x = conv(x, **kw)
                 if i == self.num_layers - 2 and it == self.num_iter - 1:
-                    return masked_mean_pool(x, node_mask)
+                    return readout(x)
                 x = nn.relu(x)

@@ -22,7 +22,7 @@ from flax import linen as nn
 
 from ..config.schema import AgentConfig
 from ..env.observations import GraphObs
-from .gnn import GNNEmbedder
+from .gnn import GNNEmbedder, masked_mean_pool
 
 
 class MLP(nn.Module):
@@ -47,44 +47,147 @@ def _embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
                        impl=impl)
 
 
+def _node_embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
+    return GNNEmbedder(hidden=agent.gnn_features,
+                       num_layers=agent.gnn_num_layers,
+                       num_iter=agent.gnn_num_iter,
+                       mean_aggr=agent.gnn_aggr == "mean",
+                       impl=impl, pool=False)
+
+
+# action dims (N * C * S * N') above which the monolithic Dense output
+# layer stops fitting one chip (a 256-hidden head on the rung-5 393k-dim
+# action is a ~100M-param matrix, measured RESOURCE_EXHAUSTED even at B=4
+# — BENCH_NOTES r3) and the factored decoder takes over by default
+FACTORED_HEAD_THRESHOLD = 16384
+
+
+def use_factored_head(agent: AgentConfig, action_dim: int) -> bool:
+    if agent.factored_head is not None:
+        return agent.factored_head and agent.graph_mode
+    return agent.graph_mode and action_dim >= FACTORED_HEAD_THRESHOLD
+
+
 class Actor(nn.Module):
-    """Policy network (models.py:97-153)."""
+    """Policy network (models.py:97-153).
+
+    Two heads over the shared GNN trunk:
+
+    - monolithic (the reference's shape): graph embedding ++ mask -> MLP ->
+      Dense(action_dim).  Exact reference semantics, but the output matrix
+      scales as hidden x (N*C*S*N) — ~100M params at rung-5 padding.
+    - factored (``use_factored_head``): the schedule is structured
+      [src, sfc, sf, dst], so score it as a bilinear form between per-node
+      embeddings: h_src -> per-(sfc, sf) query vectors, h_dst -> key
+      vectors, logits[n,c,s,m] = <q[n,c,s], k[m]>.  Parameters scale with
+      C*S*hidden*key_dim instead of N^2*C*S*hidden (~2000x fewer at
+      rung 5), and every op is an einsum on the MXU.
+
+    Both heads multiply by ``obs.mask`` so padded (src, dst) entries are
+    exactly zero (models.py:146-153)."""
 
     agent: AgentConfig
     action_dim: int
     gnn_impl: str = "dense"
+    # (N, C, S, N') of the scheduling tensor; required for the factored head
+    sched_shape: Tuple[int, int, int, int] = None
 
     @nn.compact
     def __call__(self, obs):
-        if self.agent.graph_mode:
-            assert isinstance(obs, GraphObs)
+        if not self.agent.graph_mode:
+            return MLP(tuple(self.agent.actor_hidden_layer_nodes)
+                       + (self.action_dim,))(obs)
+        assert isinstance(obs, GraphObs)
+        if use_factored_head(self.agent, self.action_dim):
+            if self.sched_shape is None:
+                raise ValueError(
+                    "factored action head needs sched_shape=(N, C, S, N') "
+                    "(see EnvLimits.scheduling_shape)")
+            n, c, s, n2 = self.sched_shape
+            if n * c * s * n2 != self.action_dim:
+                raise ValueError(f"sched_shape {self.sched_shape} does not "
+                                 f"factor action_dim {self.action_dim}")
+            feats = _node_embedder(self.agent, self.gnn_impl)(
+                obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
+            pooled = masked_mean_pool(feats, obs.node_mask)
+            # per-src hidden through the configured actor stack (global
+            # context broadcast onto every node)
+            h = jnp.concatenate(
+                [feats, jnp.broadcast_to(pooled[..., None, :],
+                                         feats.shape[:-1] + pooled.shape[-1:])],
+                axis=-1)
+            h = MLP(tuple(self.agent.actor_hidden_layer_nodes))(h)
+            h = nn.relu(h)
+            g = self.agent.factored_key_dim
+            q = nn.Dense(c * s * g, name="query")(h)      # [.., N, C*S*G]
+            k = nn.Dense(g, name="key")(feats)            # [.., N', G]
+            q = q.reshape(q.shape[:-2] + (n, c, s, g))
+            out = jnp.einsum("...ncsg,...mg->...ncsm", q, k)
+            out = out.reshape(out.shape[:-4] + (self.action_dim,))
+        else:
             emb = _embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
             h = jnp.concatenate([emb, obs.mask], axis=-1)
-        else:
-            h = obs
-        out = MLP(tuple(self.agent.actor_hidden_layer_nodes)
-                  + (self.action_dim,))(h)
-        if self.agent.graph_mode:
-            out = out * obs.mask
-        return out
+            out = MLP(tuple(self.agent.actor_hidden_layer_nodes)
+                      + (self.action_dim,))(h)
+        return out * obs.mask
 
 
 class QNetwork(nn.Module):
-    """Critic Q(s, a) (models.py:55-95)."""
+    """Critic Q(s, a) (models.py:55-95).
+
+    Factored mode mirrors the actor: the [src, sfc, sf, dst] action is
+    contracted against per-node key vectors over the dst axis, giving
+    per-src action features that join the node embeddings; a per-node
+    Dense + masked mean-pool reduces to a fixed-size vector regardless of
+    N, and the configured critic MLP scores it.  (The monolithic head's
+    explicit mask input is dropped here: the mask is derived purely from
+    node_mask — actions.py action_mask — and node validity already enters
+    through the GNN.  Replayed actions DO carry mass on masked entries
+    after exploration noise / renormalization; the critic simply reads it
+    through the same contraction.)
+
+    The factoring decision keys on ``action.shape[-1]`` at call time, so a
+    construction site cannot accidentally pick the monolithic head by
+    omitting a field."""
 
     agent: AgentConfig
     gnn_impl: str = "dense"
+    action_dim: int = 0       # informational; the call uses action.shape[-1]
+    sched_shape: Tuple[int, int, int, int] = None
 
     @nn.compact
     def __call__(self, obs, action):
-        if self.agent.graph_mode:
-            assert isinstance(obs, GraphObs)
+        if not self.agent.graph_mode:
+            return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,))(
+                jnp.concatenate([obs, action], axis=-1))
+        assert isinstance(obs, GraphObs)
+        if use_factored_head(self.agent, action.shape[-1]):
+            if self.sched_shape is None:
+                raise ValueError(
+                    "factored action head needs sched_shape=(N, C, S, N') "
+                    "(see EnvLimits.scheduling_shape)")
+            n, c, s, n2 = self.sched_shape
+            if n * c * s * n2 != action.shape[-1]:
+                raise ValueError(f"sched_shape {self.sched_shape} does not "
+                                 f"factor action dim {action.shape[-1]}")
+            feats = _node_embedder(self.agent, self.gnn_impl)(
+                obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
+            pooled = masked_mean_pool(feats, obs.node_mask)
+            g = self.agent.factored_key_dim
+            a4 = action.reshape(action.shape[:-1] + (n, c, s, n2))
+            k = nn.Dense(g, name="key")(feats)            # [.., N', G]
+            a_enc = jnp.einsum("...ncsm,...mg->...ncsg", a4, k)
+            z = jnp.concatenate(
+                [feats, a_enc.reshape(a_enc.shape[:-3] + (c * s * g,))],
+                axis=-1)
+            z = nn.relu(nn.Dense(self.agent.gnn_features, name="src")(z))
+            z = masked_mean_pool(z, obs.node_mask)
+            h = jnp.concatenate([pooled, z], axis=-1)
+        else:
             emb = _embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
             h = jnp.concatenate([emb, obs.mask, action], axis=-1)
-        else:
-            h = jnp.concatenate([obs, action], axis=-1)
         return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,))(h)
 
 

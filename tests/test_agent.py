@@ -37,7 +37,8 @@ def line_topo():
     return compile_topology(spec, max_nodes=N, max_edges=E)
 
 
-def make_stack(episode_steps=4, warmup=4, graph_mode=True, sim_kwargs=None):
+def make_stack(episode_steps=4, warmup=4, graph_mode=True, sim_kwargs=None,
+               agent_kwargs=None):
     service = make_service()
     limits = EnvLimits(max_nodes=N, max_edges=E, num_sfcs=1, max_sfs=3)
     agent = AgentConfig(
@@ -45,7 +46,7 @@ def make_stack(episode_steps=4, warmup=4, graph_mode=True, sim_kwargs=None):
         nb_steps_warmup_critic=warmup,
         gnn_features=8, actor_hidden_layer_nodes=(16,),
         critic_hidden_layer_nodes=(16,), mem_limit=64, batch_size=4,
-        objective="prio-flow")
+        objective="prio-flow", **(agent_kwargs or {}))
     cfg = SimConfig(ttl_choices=(100.0,), **(sim_kwargs or {}))
     env = ServiceCoordEnv(service, cfg, agent, limits)
     topo = line_topo()
@@ -121,16 +122,12 @@ def test_gradient_step_changes_params_and_targets_slowly():
     assert np.isfinite(float(metrics["critic_loss"]))
 
 
-# ------------------------------------------------------------- end-to-end
-@pytest.mark.parametrize("graph_mode", [True, False])
-def test_trainer_smoke(tmp_path, graph_mode):
-    """3 episodes of 4 steps end-to-end: rollout scan + learn burst, reward
-    history recorded, rewards.csv written."""
-    env, agent, topo, traffic = make_stack(graph_mode=graph_mode)
-    scheduler = SchedulerConfig(training_network_files=("x",),
-                                inference_network="x", period=10)
+def make_driver(env, agent, topo, traffic):
+    """Single-topology EpisodeDriver stub shared by the trainer tests
+    (and tests/test_telemetry.py's make_trainer)."""
     driver = EpisodeDriver.__new__(EpisodeDriver)
-    driver.scheduler = scheduler
+    driver.scheduler = SchedulerConfig(training_network_files=("x",),
+                                       inference_network="x", period=10)
     driver.sim_cfg = env.sim_cfg
     driver.service = env.service
     driver.episode_steps = agent.episode_steps
@@ -139,12 +136,35 @@ def test_trainer_smoke(tmp_path, graph_mode):
     driver.inference_topology = topo
     driver.trace = None
     driver.capacity = traffic.capacity
+    return driver
 
+
+# ------------------------------------------------------------- end-to-end
+@pytest.mark.parametrize("graph_mode", [True, False])
+def test_trainer_smoke(tmp_path, graph_mode):
+    """3 episodes of 4 steps end-to-end: rollout scan + learn burst, reward
+    history recorded, rewards.csv written."""
+    env, agent, topo, traffic = make_stack(graph_mode=graph_mode)
+    driver = make_driver(env, agent, topo, traffic)
     trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path))
     state, _ = trainer.train(episodes=3)
     assert len(trainer.history) == 3
     rows = (tmp_path / "rewards.csv").read_text().strip().splitlines()
     assert rows[0] == "r" and len(rows) == 4
+    result = trainer.evaluate(state, episodes=1)
+    assert np.isfinite(result["mean_return"])
+
+
+def test_trainer_smoke_factored_head(tmp_path):
+    """End-to-end rollout + learn with the factored per-node action head
+    (the rung-5 scale path, forced on here at toy size)."""
+    env, agent, topo, traffic = make_stack(
+        agent_kwargs={"factored_head": True, "factored_key_dim": 4})
+    driver = make_driver(env, agent, topo, traffic)
+    trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path))
+    state, _ = trainer.train(episodes=2)
+    assert len(trainer.history) == 2
+    assert np.isfinite(trainer.history[-1]["critic_loss"])
     result = trainer.evaluate(state, episodes=1)
     assert np.isfinite(result["mean_return"])
 
@@ -158,19 +178,8 @@ def test_exact_resume_matches_straight_run(tmp_path):
 
     def build():
         env, agent, topo, traffic = make_stack()
-        scheduler = SchedulerConfig(training_network_files=("x",),
-                                    inference_network="x", period=10)
-        driver = EpisodeDriver.__new__(EpisodeDriver)
-        driver.scheduler = scheduler
-        driver.sim_cfg = env.sim_cfg
-        driver.service = env.service
-        driver.episode_steps = agent.episode_steps
-        driver.base_seed = 0
-        driver.topologies = [topo]
-        driver.inference_topology = topo
-        driver.trace = None
-        driver.capacity = traffic.capacity
-        return Trainer(env, driver, agent, seed=3)
+        return Trainer(env, make_driver(env, agent, topo, traffic), agent,
+                       seed=3)
 
     # straight 4-episode run
     t_a = build()

@@ -141,6 +141,67 @@ def test_critic_batched():
     assert out.shape == (6, 1)
 
 
+def test_factored_actor_mask_shapes_and_param_scaling():
+    """Factored head: same output contract as the monolithic head (shape,
+    exact zeros at masked entries, batch dims) with parameters independent
+    of the N x N' output plane (VERDICT r3 #4: the rung-5 monolithic head
+    is a ~100M-param matrix that OOMs one chip)."""
+    agent = AgentConfig(graph_mode=True, gnn_features=8,
+                        actor_hidden_layer_nodes=(32,), factored_head=True,
+                        factored_key_dim=4)
+    obs = make_obs()
+    action_dim = N * 1 * 2 * N
+    actor = Actor(agent=agent, action_dim=action_dim,
+                  sched_shape=(N, 1, 2, N))
+    params = actor.init(jax.random.PRNGKey(0), obs)
+    out = actor.apply(params, obs)
+    assert out.shape == (action_dim,)
+    np.testing.assert_array_equal(np.asarray(out)[1::2], 0.0)
+    # batched
+    obs_b = make_obs(batch=(3,))
+    assert actor.apply(params, obs_b).shape == (3, action_dim)
+
+    count = lambda p: sum(x.size for x in jax.tree_util.tree_leaves(p))
+    mono = Actor(agent=AgentConfig(graph_mode=True, gnn_features=8,
+                                   actor_hidden_layer_nodes=(32,),
+                                   factored_head=False),
+                 action_dim=action_dim, sched_shape=(N, 1, 2, N))
+    n_fact = count(params)
+    n_mono = count(mono.init(jax.random.PRNGKey(0), obs))
+    # even at this toy size the factored head is smaller; at rung-5
+    # padding the ratio is ~2000x
+    assert n_fact < n_mono
+
+
+def test_factored_critic_batched_and_action_sensitivity():
+    agent = AgentConfig(graph_mode=True, gnn_features=8,
+                        critic_hidden_layer_nodes=(16,), factored_head=True,
+                        factored_key_dim=4)
+    obs = make_obs(batch=(6,))
+    action_dim = N * 1 * 2 * N
+    q = QNetwork(agent=agent, action_dim=action_dim,
+                 sched_shape=(N, 1, 2, N))
+    action = jnp.ones((6, action_dim)) * 0.5
+    params = q.init(jax.random.PRNGKey(0), obs, action)
+    out = q.apply(params, obs, action)
+    assert out.shape == (6, 1)
+    out2 = q.apply(params, obs, action * 0.0)
+    assert not np.allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_factored_head_auto_threshold():
+    from gsc_tpu.models.nets import (FACTORED_HEAD_THRESHOLD,
+                                     use_factored_head)
+    g = AgentConfig(graph_mode=True)
+    assert not use_factored_head(g, 1728)              # flagship: monolithic
+    assert use_factored_head(g, FACTORED_HEAD_THRESHOLD)   # rung-5 scale
+    assert use_factored_head(
+        AgentConfig(graph_mode=True, factored_head=True), 16)
+    assert not use_factored_head(
+        AgentConfig(graph_mode=True, factored_head=False), 10 ** 6)
+    assert not use_factored_head(AgentConfig(graph_mode=False), 10 ** 6)
+
+
 def test_flat_mode_networks():
     agent = AgentConfig(graph_mode=False)
     obs = jnp.ones((4, 24))

@@ -12,22 +12,11 @@ from tests.test_agent import make_stack
 
 
 def make_trainer(tmp_path, **kw):
-    from gsc_tpu.config.schema import SchedulerConfig
-    from gsc_tpu.env import EpisodeDriver
+    from tests.test_agent import make_driver
 
     env, agent, topo, traffic = make_stack(**kw)
-    driver = EpisodeDriver.__new__(EpisodeDriver)
-    driver.scheduler = SchedulerConfig(training_network_files=("x",),
-                                       inference_network="x", period=10)
-    driver.sim_cfg = env.sim_cfg
-    driver.service = env.service
-    driver.episode_steps = agent.episode_steps
-    driver.base_seed = 0
-    driver.topologies = [topo]
-    driver.inference_topology = topo
-    driver.trace = None
-    driver.capacity = traffic.capacity
-    return Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path))
+    return Trainer(env, make_driver(env, agent, topo, traffic), agent,
+                   seed=0, result_dir=str(tmp_path))
 
 
 def test_telemetry_csv_suite(tmp_path):
@@ -69,8 +58,17 @@ def test_overload_surfaces_truncated_arrivals(tmp_path, caplog):
 
     trainer = make_trainer(
         tmp_path, sim_kwargs={"max_flows": 4, "inter_arrival_mean": 1.0})
-    with caplog.at_level(logging.WARNING, logger="gsc_tpu.agents.trainer"):
-        state, _ = trainer.train(episodes=1)
+    # caplog captures via root-logger propagation, which setup_logging
+    # (exercised by other tests in the session) turns off for the package
+    pkg = logging.getLogger("gsc_tpu")
+    old_propagate = pkg.propagate
+    pkg.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="gsc_tpu.agents.trainer"):
+            state, _ = trainer.train(episodes=1)
+    finally:
+        pkg.propagate = old_propagate
     assert any("admitted late" in r.message for r in caplog.records)
     trainer.evaluate(state, episodes=1, telemetry=True)
     with open(tmp_path / "test" / "metrics.csv") as f:
