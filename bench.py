@@ -210,10 +210,9 @@ def _interroute_stack(episode_steps):
     # at 128 max nodes the action/mask dim is 128*1*3*128 = 49k floats per
     # transition, and the flagship mem_limit=10000 OOMs one chip's HBM at
     # B=32 (312 transitions/replica, measured RESOURCE_EXHAUSTED in the
-    # learn burst).  This cap makes per-replica capacity floor at
-    # batch_size=100 (ParallelDDPG.init_buffers), which fits and ran at
-    # 99 env-steps/s; it changes nothing at B >= 100 where the floor
-    # already binds.
+    # learn burst).  2048 total transitions (~mem_limit // B per replica,
+    # ParallelDDPG.init_buffers) fit; the r3 run banked 99 env-steps/s
+    # with an equivalent budget.
     agent = dataclasses.replace(agent, mem_limit=2048)
     return env, agent, topo
 
@@ -238,7 +237,9 @@ def _rung5_stack(episode_steps):
     # the network config ports up the ladder unchanged.  Only the replay
     # BUDGET stays scenario-sized: a rung-5 transition carries ~1.2M f32
     # (two 393k masks + a 393k action), so the flagship's 10000-transition
-    # replay would be ~47 GB; 1024 transitions ~ 5 GB fits one chip.
+    # replay would be ~47 GB; mem_limit=1024 keeps TOTAL replay at 1024
+    # transitions ~ 5 GB at every B (init_buffers splits mem_limit over
+    # replicas with no per-shard floor).
     agent = AgentConfig(graph_mode=True, episode_steps=episode_steps,
                         objective="prio-flow", mem_limit=1024)
     sim_cfg = SimConfig(ttl_choices=(100.0,), max_flows=1024)

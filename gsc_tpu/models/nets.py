@@ -68,6 +68,18 @@ def use_factored_head(agent: AgentConfig, action_dim: int) -> bool:
     return agent.graph_mode and action_dim >= FACTORED_HEAD_THRESHOLD
 
 
+def _check_sched_shape(sched_shape, action_dim: int) -> Tuple[int, ...]:
+    if sched_shape is None:
+        raise ValueError(
+            "factored action head needs sched_shape=(N, C, S, N') "
+            "(see EnvLimits.scheduling_shape)")
+    n, c, s, n2 = sched_shape
+    if n * c * s * n2 != action_dim:
+        raise ValueError(f"sched_shape {sched_shape} does not factor "
+                         f"action dim {action_dim}")
+    return n, c, s, n2
+
+
 class Actor(nn.Module):
     """Policy network (models.py:97-153).
 
@@ -99,14 +111,8 @@ class Actor(nn.Module):
                        + (self.action_dim,))(obs)
         assert isinstance(obs, GraphObs)
         if use_factored_head(self.agent, self.action_dim):
-            if self.sched_shape is None:
-                raise ValueError(
-                    "factored action head needs sched_shape=(N, C, S, N') "
-                    "(see EnvLimits.scheduling_shape)")
-            n, c, s, n2 = self.sched_shape
-            if n * c * s * n2 != self.action_dim:
-                raise ValueError(f"sched_shape {self.sched_shape} does not "
-                                 f"factor action_dim {self.action_dim}")
+            n, c, s, n2 = _check_sched_shape(self.sched_shape,
+                                             self.action_dim)
             feats = _node_embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
             pooled = masked_mean_pool(feats, obs.node_mask)
@@ -163,14 +169,8 @@ class QNetwork(nn.Module):
                 jnp.concatenate([obs, action], axis=-1))
         assert isinstance(obs, GraphObs)
         if use_factored_head(self.agent, action.shape[-1]):
-            if self.sched_shape is None:
-                raise ValueError(
-                    "factored action head needs sched_shape=(N, C, S, N') "
-                    "(see EnvLimits.scheduling_shape)")
-            n, c, s, n2 = self.sched_shape
-            if n * c * s * n2 != action.shape[-1]:
-                raise ValueError(f"sched_shape {self.sched_shape} does not "
-                                 f"factor action dim {action.shape[-1]}")
+            n, c, s, n2 = _check_sched_shape(self.sched_shape,
+                                             action.shape[-1])
             feats = _node_embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
             pooled = masked_mean_pool(feats, obs.node_mask)

@@ -54,17 +54,28 @@ class ParallelDDPG:
         """Replicated learner state (init from a single-replica obs)."""
         return self.ddpg.init(rng, sample_obs)
 
-    def init_buffers(self, sample_obs) -> ReplayBuffer:
+    def init_buffers(self, sample_obs,
+                     num_replicas: int = None) -> ReplayBuffer:
         """Per-replica replay shards: leaves [B, capacity, ...]; capacity is
-        mem_limit / B so total memory matches the single-env agent."""
-        cap = max(self.agent.mem_limit // self.B, self.agent.batch_size)
+        mem_limit / B (floored at 1) so TOTAL memory matches the single-env
+        agent's budget regardless of replica count — sampling is
+        with-replacement, so small per-shard capacities stay valid.
+
+        ``num_replicas`` overrides the leading axis for multi-PROCESS runs:
+        each process allocates only its local shard (global B still sizes
+        the per-replica capacity) and converts it with
+        ``host_local_array_to_global_array`` — materializing the global
+        buffer on one device first would transiently hold process_count
+        times the per-chip replay budget."""
+        cap = max(self.agent.mem_limit // self.B, 1)
+        b = self.B if num_replicas is None else num_replicas
         example = self.ddpg.example_transition(sample_obs)
         data = jax.tree_util.tree_map(
-            lambda x: jnp.zeros((self.B, cap) + jnp.shape(x),
+            lambda x: jnp.zeros((b, cap) + jnp.shape(x),
                                 jnp.asarray(x).dtype),
             flatten_transition(example))
-        return ReplayBuffer(data=data, pos=jnp.zeros(self.B, jnp.int32),
-                            size=jnp.zeros(self.B, jnp.int32),
+        return ReplayBuffer(data=data, pos=jnp.zeros(b, jnp.int32),
+                            size=jnp.zeros(b, jnp.int32),
                             shapes=transition_shapes(example))
 
     @partial(jax.jit, static_argnums=0)

@@ -47,6 +47,47 @@ def force_virtual_cpu(n_devices: int) -> None:
             f"need {n_devices}")
 
 
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host runtime initialization (``jax.distributed.initialize``).
+
+    Call ONCE per process, before any backend touch.  With no arguments,
+    coordinates from the environment (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``, or the cloud autodetection
+    jax ships).  After this, ``jax.devices()`` is GLOBAL across all
+    processes and ``make_mesh()``/``make_hybrid_mesh()`` build pod-wide
+    meshes; each process addresses only ``jax.local_devices()``.
+
+    The reference has nothing comparable (SURVEY §5: one process, one CPU);
+    this is the entry point BASELINE config 5's data-parallel v5e-16 run
+    crosses hosts through."""
+    kw = {}
+    if coordinator is not None:
+        kw["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
+def make_hybrid_mesh(outer_axis: str = "dcn", axis: str = "dp") -> Mesh:
+    """2-D (process, local-device) mesh: the outer axis crosses hosts (DCN
+    on a multi-slice pod, ICI within a slice), the inner axis crosses each
+    process's local chips.  Shard replicas over BOTH axes and keep
+    parameters replicated: the gradient psum then reduces over ICI first
+    and crosses DCN once per step — the standard DCN-last layout.
+
+    Falls back to a [1, n] grid in single-process runs, so code written
+    against (outer, inner) axis names runs unchanged on one host."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_proc = jax.process_count()
+    local = len(devs) // max(n_proc, 1)
+    grid = np.asarray(devs).reshape(n_proc, local)
+    return Mesh(grid, (outer_axis, axis))
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     """1-D mesh over the first ``n_devices`` devices (default: all).
 

@@ -187,7 +187,13 @@ def test_bench_rung5_scenario_matches_config5():
     assert env.limits.num_sfcs == 2 and env.limits.sf_pool == 5
     assert set(env.service.sfc_list) == {"sfc_1", "sfc_2"}
     assert env.sim_cfg.max_flows == 1024
-    # scenario hyperparameters sized to fit one chip's HBM at the 393k-dim
-    # padded action (see the constructor's comment)
-    assert agent.mem_limit == 512 and agent.batch_size == 32
-    assert agent.actor_hidden_layer_nodes == (64,)
+    # FLAGSHIP architecture ports up the ladder: the factored head
+    # auto-enables at this action dim, so the default 256/64 hidden sizes
+    # and batch 100 carry over; only the replay BUDGET is scenario-sized
+    # (a rung-5 transition is ~1.2M f32)
+    from gsc_tpu.models.nets import use_factored_head
+    assert use_factored_head(agent, env.limits.action_dim)
+    assert agent.actor_hidden_layer_nodes == (256,)
+    assert agent.critic_hidden_layer_nodes == (64,)
+    assert agent.batch_size == 100
+    assert agent.mem_limit == 1024

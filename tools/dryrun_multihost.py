@@ -1,0 +1,156 @@
+"""Multi-HOST dryrun: the full sharded train step across N separate
+processes, each owning a slice of a virtual CPU mesh.
+
+``dryrun_multichip`` (driver contract) proves the multi-chip shardings on
+one process; this tool proves the MULTI-PROCESS half of the distributed
+backend (VERDICT r3 missing #1): ``jax.distributed.initialize`` over a
+localhost coordinator, a global mesh built from all processes' devices,
+per-process host data fed in via ``host_local_array_to_global_array``,
+and one rollout+learn step whose gradient psum crosses process boundaries.
+No TPU needed — same SPMD code path a v5e-16 data-parallel run takes,
+with gRPC standing in for ICI/DCN.
+
+Launcher::
+
+    python tools/dryrun_multihost.py                 # 2 procs x 4 devices
+    python tools/dryrun_multihost.py --procs 2 --devices-per-proc 2
+
+Each worker prints its local view; process 0 prints the final
+``dryrun_multihost(P x D): ok`` line the caller greps for.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def launch(procs: int, devices_per_proc: int, timeout: int = 600) -> int:
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k != "PALLAS_AXON_POOL_IPS"}  # never touch the TPU plugin
+    workers = []
+    for pid in range(procs):
+        env = dict(env_base)
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices_per_proc}")
+        workers.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             str(pid), str(procs), str(port), str(devices_per_proc)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    rc = 0
+    deadline = time.time() + timeout
+    for pid, w in enumerate(workers):
+        try:
+            out, _ = w.communicate(timeout=max(deadline - time.time(), 1))
+        except subprocess.TimeoutExpired:
+            w.kill()
+            out, _ = w.communicate()
+            rc = rc or 124
+        sys.stderr.write(f"--- worker {pid} (rc={w.returncode}) ---\n"
+                         + out[-2000:])
+        if pid == 0 and w.returncode == 0:
+            for line in out.splitlines():
+                if line.startswith("dryrun_multihost"):
+                    print(line)
+        rc = rc or w.returncode
+    return rc
+
+
+def worker(pid: int, procs: int, port: int, devices_per_proc: int) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, REPO)
+    from gsc_tpu.parallel.mesh import init_distributed
+
+    init_distributed(coordinator=f"localhost:{port}",
+                     num_processes=procs, process_id=pid)
+    assert jax.process_count() == procs
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    print(f"[worker {pid}] global devices={n_global} local={n_local}")
+    assert n_local == devices_per_proc, (n_local, devices_per_proc)
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from __graft_entry__ import _flagship
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.parallel.mesh import make_hybrid_mesh
+    from gsc_tpu.sim.traffic import generate_traffic
+
+    env, agent, topo, _ = _flagship(max_nodes=8, max_edges=8,
+                                    episode_steps=2, max_flows=32,
+                                    gen_traffic=False)
+    B = n_global            # one env replica per global device
+    B_local = n_local
+    mesh = make_hybrid_mesh()           # [procs, local] (dcn, dp)
+    spec = P(("dcn", "dp"))             # replicas sharded over both axes
+
+    def to_global(tree):
+        return multihost_utils.host_local_array_to_global_array(
+            tree, mesh, spec)
+
+    # each process materializes only ITS replicas' traffic and replay shard
+    local_seeds = range(pid * B_local, (pid + 1) * B_local)
+    traffic = to_global(jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[generate_traffic(env.sim_cfg, env.service, topo, 2, seed=s)
+          for s in local_seeds]))
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, sample_mode="local")
+
+    # replicated inputs (identical on every process) pass as host values;
+    # a single-replica reset builds the learner-init example
+    one_traffic = generate_traffic(env.sim_cfg, env.service, topo, 2, seed=0)
+    _, one_obs = env.reset(jax.random.PRNGKey(0), topo, one_traffic)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    # allocate only the LOCAL replay shard (global B still sizes capacity)
+    buffers = to_global(pddpg.init_buffers(one_obs, num_replicas=B_local))
+
+    with mesh:
+        env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo,
+                                          traffic)
+        state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+            state, buffers, env_states, obs, topo, traffic, jnp.int32(0))
+        state, metrics = pddpg.learn_burst(state, buffers)
+        jax.block_until_ready((stats, metrics))
+
+    # the reductions inside the jitted steps leave these fully replicated,
+    # so every process can read them directly
+    ret = float(stats["episodic_return"])
+    loss = float(metrics["critic_loss"])
+    if pid == 0:
+        print(f"dryrun_multihost({procs}x{devices_per_proc}): ok — "
+              f"return={ret:.3f} critic_loss={loss:.4f}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--worker", nargs=4, type=int, default=None,
+                    metavar=("PID", "PROCS", "PORT", "DEVS"))
+    ap.add_argument("--timeout", type=int, default=600)
+    args = ap.parse_args()
+    if args.worker is not None:
+        worker(*args.worker)
+    else:
+        sys.exit(launch(args.procs, args.devices_per_proc, args.timeout))
+
+
+if __name__ == "__main__":
+    main()

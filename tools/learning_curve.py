@@ -36,11 +36,23 @@ def main():
                     help="per-episode traffic on the HOST (the r3 path; "
                     "ships ~90 MB/episode at B=256 through the device "
                     "tunnel).  Default is on-device sampling.")
+    # multi-host: launch one process per host with identical arguments
+    # plus --coordinator host0:port --num-processes P --process-id i.
+    # --replicas is then the GLOBAL replica count (must divide by P).
+    ap.add_argument("--coordinator", default=None,
+                    help="multi-host coordinator address host:port")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
     args = ap.parse_args()
 
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    multihost = args.coordinator is not None
+    if multihost:
+        from gsc_tpu.parallel.mesh import init_distributed
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
     import jax.numpy as jnp
 
     from __graft_entry__ import _flagship
@@ -52,59 +64,97 @@ def main():
     assert T % chunk == 0
     env, agent, topo, _ = _flagship(episode_steps=T)
 
+    # multi-host: global (dcn, dp) mesh, replicas sharded over both axes,
+    # per-process host data fed in as local shards (same SPMD pattern as
+    # tools/dryrun_multihost.py); single-host: everything below is a no-op
+    # passthrough
+    if multihost:
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from gsc_tpu.parallel.mesh import make_hybrid_mesh
+        n_proc = jax.process_count()
+        pid = jax.process_index()
+        assert B % n_proc == 0, (B, n_proc)
+        B_local = B // n_proc
+        mesh = make_hybrid_mesh()
+        spec = P(("dcn", "dp"))
+        sharded = NamedSharding(mesh, spec)
+        to_global = lambda tree: \
+            multihost_utils.host_local_array_to_global_array(tree, mesh, spec)
+        mesh_ctx = mesh
+    else:
+        import contextlib
+        n_proc, pid, B_local = 1, 0, B
+        sharded = None
+        to_global = lambda tree: tree
+        mesh_ctx = contextlib.nullcontext()
+
     if args.host_traffic:
         def episode_traffic(ep):
+            # each process builds only its replicas' traces
             t0 = [generate_traffic(env.sim_cfg, env.service, topo, T,
-                                   seed=1000 * ep + s) for s in range(B)]
-            return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *t0)
+                                   seed=1000 * ep + pid * B_local + s)
+                  for s in range(B_local)]
+            return to_global(
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *t0))
     else:
         dt = DeviceTraffic(env.sim_cfg, env.service, topo, T)
-        sample_batch = jax.jit(lambda k: dt.sample_batch(k, B))
+        sample_batch = jax.jit(lambda k: dt.sample_batch(k, B),
+                               out_shardings=sharded)
 
         def episode_traffic(ep):
             return sample_batch(jax.random.fold_in(
                 jax.random.PRNGKey(args.seed + 3), ep))
 
-    traffic = episode_traffic(0)
-    pddpg = ParallelDDPG(env, agent, num_replicas=B)
-    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(args.seed), topo,
-                                      traffic)
-    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B,
+                         sample_mode="local" if multihost else "across")
+    # single-replica reset (identical on every process) for learner init
+    one_traffic = generate_traffic(env.sim_cfg, env.service, topo, T, seed=0)
+    _, one_obs = env.reset(jax.random.PRNGKey(args.seed), topo, one_traffic)
     state = pddpg.init(jax.random.PRNGKey(args.seed + 1), one_obs)
-    buffers = pddpg.init_buffers(one_obs)
+    # each process allocates only its local replay shard
+    buffers = to_global(pddpg.init_buffers(
+        one_obs, num_replicas=B_local if multihost else None))
+    traffic = episode_traffic(0)
 
     returns, succ = [], []
     t0 = time.time()
-    for ep in range(args.episodes):
-        # fresh per-episode traffic like the trainer (device resample by
-        # default: no host->device flow-tensor transfer between episodes);
-        # episode 0 reuses the pre-loop sample
-        if ep:
-            traffic = episode_traffic(ep)
-        env_states, obs = pddpg.reset_all(
-            jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), ep),
-            topo, traffic)
-        for c in range(T // chunk):
-            start = jnp.int32(ep * T + c * chunk)
-            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
-                state, buffers, env_states, obs, topo, traffic, start, chunk)
-        state, metrics = pddpg.learn_burst(state, buffers)
-        r = float(stats["episodic_return"])
-        s = float(stats["mean_succ_ratio"])
-        returns.append(r)
-        succ.append(s)
-        print(f"episode={ep} return={r:.3f} succ={s:.3f} "
-              f"critic_loss={float(metrics['critic_loss']):.4f} "
-              f"elapsed={time.time() - t0:.0f}s", file=sys.stderr)
+    with mesh_ctx:
+        for ep in range(args.episodes):
+            # fresh per-episode traffic like the trainer (device resample
+            # by default: no host->device flow-tensor transfer between
+            # episodes); episode 0 reuses the pre-loop sample
+            if ep:
+                traffic = episode_traffic(ep)
+            env_states, obs = pddpg.reset_all(
+                jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), ep),
+                topo, traffic)
+            for c in range(T // chunk):
+                start = jnp.int32(ep * T + c * chunk)
+                state, buffers, env_states, obs, stats = \
+                    pddpg.rollout_episodes(state, buffers, env_states, obs,
+                                           topo, traffic, start, chunk)
+            state, metrics = pddpg.learn_burst(state, buffers)
+            r = float(stats["episodic_return"])
+            s = float(stats["mean_succ_ratio"])
+            returns.append(r)
+            succ.append(s)
+            if pid == 0:
+                print(f"episode={ep} return={r:.3f} succ={s:.3f} "
+                      f"critic_loss={float(metrics['critic_loss']):.4f} "
+                      f"elapsed={time.time() - t0:.0f}s", file=sys.stderr)
     k = min(10, max(1, len(returns) // 4))
-    print(json.dumps({
-        "replicas": B, "episodes": args.episodes, "episode_steps": T,
-        "first_k_return": round(sum(returns[:k]) / k, 3),
-        "last_k_return": round(sum(returns[-k:]) / k, 3),
-        "first_k_succ": round(sum(succ[:k]) / k, 4),
-        "last_k_succ": round(sum(succ[-k:]) / k, 4),
-        "wall_s": round(time.time() - t0, 1),
-    }))
+    if pid == 0:
+        print(json.dumps({
+            "replicas": B, "episodes": args.episodes, "episode_steps": T,
+            "processes": n_proc,
+            "first_k_return": round(sum(returns[:k]) / k, 3),
+            "last_k_return": round(sum(returns[-k:]) / k, 3),
+            "first_k_succ": round(sum(succ[:k]) / k, 4),
+            "last_k_succ": round(sum(succ[-k:]) / k, 4),
+            "wall_s": round(time.time() - t0, 1),
+        }))
 
 
 if __name__ == "__main__":
