@@ -141,7 +141,7 @@ def init_configs(out: str):
 
 
 def _build(agent_config, simulator_config, service, scheduler, seed,
-           max_nodes, max_edges):
+           max_nodes, max_edges, resource_functions_path=None):
     from .config.loader import load_agent, load_scheduler, load_service, load_sim
     from .config.schema import EnvLimits
     from .env.driver import EpisodeDriver
@@ -149,7 +149,8 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 
     agent = load_agent(agent_config)
     sim_cfg = load_sim(simulator_config)
-    svc = load_service(service)
+    svc = load_service(service,
+                       resource_functions_path=resource_functions_path)
     sched = load_scheduler(scheduler)
     limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
                                    max_edges=max_edges)
@@ -181,10 +182,14 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
               help="checkpoint dir from a previous train run: restores "
                    "params+opt+targets+replay+PRNG and continues exactly "
                    "(total episode count still set by --episodes)")
+@click.option("--resource-functions-path", default=None,
+              help="dir (or .py file) of user resource-function plugins "
+                   "to register before parsing the service catalog "
+                   "(reference: reader.py:60-72 dynamic imports)")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
-          profile, runs, resume, verbose):
+          profile, runs, resume, resource_functions_path, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics)."""
@@ -217,7 +222,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         from .utils.logging import setup_logging
         setup_logging(verbose=False, logfile=os.path.join(rdir, "run.log"))
         env, driver, agent = _build(agent_config, simulator_config, service,
-                                    scheduler, run_seed, max_nodes, max_edges)
+                                    scheduler, run_seed, max_nodes, max_edges,
+                                    resource_functions_path)
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
                           tensorboard=tensorboard)
         init_state = init_buffer = None
@@ -275,8 +281,10 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
 @click.option("--seed", default=0, show_default=True)
 @click.option("--max-nodes", default=24, show_default=True)
 @click.option("--max-edges", default=37, show_default=True)
+@click.option("--resource-functions-path", default=None,
+              help="dir (or .py file) of user resource-function plugins")
 def infer(agent_config, simulator_config, service, scheduler, checkpoint,
-          episodes, seed, max_nodes, max_edges):
+          episodes, seed, max_nodes, max_edges, resource_functions_path):
     """Restore a checkpoint and run greedy test episodes
     (inference.py:17-40)."""
     from .agents.trainer import Trainer
@@ -285,7 +293,8 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
     import numpy as _np
 
     env, driver, agent = _build(agent_config, simulator_config, service,
-                                scheduler, seed, max_nodes, max_edges)
+                                scheduler, seed, max_nodes, max_edges,
+                                resource_functions_path)
     trainer = Trainer(env, driver, agent, seed=seed)
     topo, traffic = driver.episode(0, test_mode=True)
     _, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)
@@ -309,7 +318,10 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
 @click.option("--seed", default=0, show_default=True)
 @click.option("--max-nodes", default=24, show_default=True)
 @click.option("--max-edges", default=37, show_default=True)
-def simulate(duration, network, service, config, seed, max_nodes, max_edges):
+@click.option("--resource-functions-path", default=None,
+              help="dir (or .py file) of user resource-function plugins")
+def simulate(duration, network, service, config, seed, max_nodes, max_edges,
+             resource_functions_path):
     """Standalone simulator run with a uniform schedule over all nodes and
     every SF placed everywhere — the smoke-run mode of coordsim/main.py:19-89
     (which uses hard-coded dummy placement/schedule tables)."""
@@ -321,7 +333,8 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges):
     from .sim.traffic import generate_traffic
     from .topology.compiler import load_topology
 
-    svc = load_service(service)
+    svc = load_service(service,
+                       resource_functions_path=resource_functions_path)
     sim_cfg = load_sim(config)
     limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
                                    max_edges=max_edges)

@@ -28,19 +28,39 @@ def _load_yaml(path: str) -> Dict[str, Any]:
         return yaml.safe_load(f)
 
 
-def load_service(path: str) -> ServiceConfig:
-    """Parse an SFC/SF catalog yaml (reference: reader.py:47-111)."""
+def load_service(path: str,
+                 resource_functions_path: str = None) -> ServiceConfig:
+    """Parse an SFC/SF catalog yaml (reference: reader.py:47-111).
+
+    ``resource_functions_path`` loads user resource-function plugins first
+    (registry.load_resource_function_plugins — the explicit analogue of
+    the reference's per-SF dynamic imports, reader.py:60-72).  Like the
+    reference, an SF naming an unknown function falls back to "default"
+    with a warning rather than failing the run (reader.py:99-104)."""
+    import logging
+
+    from .registry import has_resource_function, load_resource_function_plugins
+
+    if resource_functions_path:
+        load_resource_function_plugins(resource_functions_path)
     data = _load_yaml(path)
     sfc_list = {name: tuple(chain) for name, chain in data["sfc_list"].items()}
     sf_list = {}
     for name, details in data["sf_list"].items():
         details = details or {}
+        rf_id = details.get("resource_function_id", "default")
+        if not has_resource_function(rf_id):
+            logging.getLogger("gsc_tpu.config").warning(
+                "SF %s names unknown resource function %r (pass "
+                "--resource-functions-path to load plugins); using default",
+                name, rf_id)
+            rf_id = "default"
         sf_list[name] = ServiceFunction(
             name=name,
             processing_delay_mean=float(details.get("processing_delay_mean", 1.0)),
             processing_delay_stdev=float(details.get("processing_delay_stdev", 1.0)),
             startup_delay=float(details.get("startup_delay", 0.0)),
-            resource_function_id=details.get("resource_function_id", "default"),
+            resource_function_id=rf_id,
         )
     return ServiceConfig(sfc_list=sfc_list, sf_list=sf_list)
 
@@ -124,11 +144,37 @@ def load_agent(path: str, **overrides) -> AgentConfig:
     return AgentConfig(**kw)
 
 
+def _resolve_network_path(p: str, anchor: str) -> str:
+    """Resolve a scheduler network path the way the reference experiment
+    layout expects: verbatim (cwd-relative / absolute) first, then against
+    each ancestor of the scheduler yaml.  Reference scheduler files carry
+    repo-root-relative paths like ``configs/networks/...`` (scheduler.yaml
+    sits at configs/config/), which only resolve when running FROM the
+    repo root — the ancestor walk makes the same file drop-in from any
+    working directory."""
+    import os
+
+    if os.path.isabs(p) or os.path.exists(p):
+        return p
+    d = os.path.dirname(os.path.abspath(anchor))
+    while True:
+        cand = os.path.join(d, p)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return p  # unresolvable: let load_topology raise with the raw path
+        d = parent
+
+
 def load_scheduler(path: str) -> SchedulerConfig:
     """Parse a scheduler yaml (reference: configs/config/scheduler.yaml)."""
     cfg = _load_yaml(path)
     return SchedulerConfig(
-        training_network_files=tuple(cfg["training_network_files"]),
-        inference_network=cfg["inference_network"],
+        training_network_files=tuple(
+            _resolve_network_path(p, path)
+            for p in cfg["training_network_files"]),
+        inference_network=_resolve_network_path(cfg["inference_network"],
+                                                path),
         period=int(cfg.get("period", 10)),
     )

@@ -36,6 +36,47 @@ def get_resource_function(name: str) -> Callable:
         ) from None
 
 
+def has_resource_function(name: str) -> bool:
+    return name in _RESOURCE_FUNCTIONS
+
+
+def load_resource_function_plugins(path: str) -> list:
+    """Import user resource-function modules and register them.
+
+    Parity with the reference's dynamic per-SF imports
+    (coordsim/reader/reader.py:60-72: ``<id>.py`` files in a
+    ``resource_functions_path`` exposing a ``resource_function(load)``
+    callable), minus the implicitness — plugins load only when the user
+    passes the path (cli ``--resource-functions-path`` / the
+    ``load_service`` argument).
+
+    ``path`` is a ``.py`` file or a directory of them.  Each module may
+    either call ``gsc_tpu.config.registry.register_resource_function``
+    itself, or simply define ``resource_function(load)`` reference-style —
+    then it is registered under the file stem.  Functions must be
+    jax-traceable elementwise maps (they run inside the jitted node
+    admission loop).  Returns the list of names registered."""
+    import importlib.util
+    import os
+
+    files = ([os.path.join(path, f) for f in sorted(os.listdir(path))
+              if f.endswith(".py")] if os.path.isdir(path) else [path])
+    registered = []
+    for fp in files:
+        stem = os.path.splitext(os.path.basename(fp))[0]
+        before = set(_RESOURCE_FUNCTIONS)
+        spec = importlib.util.spec_from_file_location(
+            f"gsc_tpu_resource_plugin_{stem}", fp)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        registered += sorted(set(_RESOURCE_FUNCTIONS) - before)
+        if stem not in _RESOURCE_FUNCTIONS and hasattr(module,
+                                                       "resource_function"):
+            _RESOURCE_FUNCTIONS[stem] = module.resource_function
+            registered.append(stem)
+    return registered
+
+
 @register_resource_function("default")
 def _identity(load):
     """Default resource demand = load (reference: reader.py:86-87)."""

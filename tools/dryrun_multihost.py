@@ -37,6 +37,8 @@ def _free_port() -> int:
 
 
 def launch(procs: int, devices_per_proc: int, timeout: int = 600) -> int:
+    import tempfile
+
     port = _free_port()
     env_base = {k: v for k, v in os.environ.items()
                 if k != "PALLAS_AXON_POOL_IPS"}  # never touch the TPU plugin
@@ -45,20 +47,29 @@ def launch(procs: int, devices_per_proc: int, timeout: int = 600) -> int:
         env = dict(env_base)
         env["XLA_FLAGS"] = (
             f"--xla_force_host_platform_device_count={devices_per_proc}")
-        workers.append(subprocess.Popen(
+        # workers write to FILES, not pipes: they block on collectives
+        # together, and one worker stalling on a full 64 KB stdout pipe
+        # while the launcher drains another would deadlock the whole run
+        log = tempfile.NamedTemporaryFile(mode="w+", prefix=f"mh{pid}_",
+                                          suffix=".log", delete=False)
+        workers.append((subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
              str(pid), str(procs), str(port), str(devices_per_proc)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
+            env=env, stdout=log, stderr=subprocess.STDOUT), log))
     rc = 0
     deadline = time.time() + timeout
-    for pid, w in enumerate(workers):
+    for pid, (w, log) in enumerate(workers):
         try:
-            out, _ = w.communicate(timeout=max(deadline - time.time(), 1))
+            w.wait(timeout=max(deadline - time.time(), 1))
         except subprocess.TimeoutExpired:
             w.kill()
-            out, _ = w.communicate()
+            w.wait()
             rc = rc or 124
+        log.flush()
+        log.seek(0)
+        out = log.read()
+        log.close()
+        os.unlink(log.name)
         sys.stderr.write(f"--- worker {pid} (rc={w.returncode}) ---\n"
                          + out[-2000:])
         if pid == 0 and w.returncode == 0:

@@ -39,6 +39,11 @@ def main():
     # multi-host: launch one process per host with identical arguments
     # plus --coordinator host0:port --num-processes P --process-id i.
     # --replicas is then the GLOBAL replica count (must divide by P).
+    ap.add_argument("--sample-mode", choices=("across", "local"),
+                    default=None,
+                    help="replay sampling: uniform across all shards vs "
+                    "shard-local stratified (default: across single-host, "
+                    "local multihost)")
     ap.add_argument("--coordinator", default=None,
                     help="multi-host coordinator address host:port")
     ap.add_argument("--num-processes", type=int, default=None)
@@ -75,7 +80,13 @@ def main():
         from gsc_tpu.parallel.mesh import make_hybrid_mesh
         n_proc = jax.process_count()
         pid = jax.process_index()
-        assert B % n_proc == 0, (B, n_proc)
+        n_local = len(jax.local_devices())
+        # replicas shard over (process, local-device), so B must divide by
+        # the full device grid — fail here, not with an opaque sharding
+        # error mid-run
+        assert B % (n_proc * n_local) == 0, \
+            f"--replicas {B} must be a multiple of " \
+            f"processes*local_devices = {n_proc}*{n_local}"
         B_local = B // n_proc
         mesh = make_hybrid_mesh()
         spec = P(("dcn", "dp"))
@@ -107,8 +118,14 @@ def main():
             return sample_batch(jax.random.fold_in(
                 jax.random.PRNGKey(args.seed + 3), ep))
 
+    # replay sampling: multihost defaults to shard-local stratified
+    # sampling (no cross-process gather in the learn loop); note the
+    # effective batch becomes B * max(batch_size // B, 1), which differs
+    # from single-host 'across' sampling — the output JSON records the
+    # mode so curves are never compared across semantics unknowingly
+    sample_mode = args.sample_mode or ("local" if multihost else "across")
     pddpg = ParallelDDPG(env, agent, num_replicas=B,
-                         sample_mode="local" if multihost else "across")
+                         sample_mode=sample_mode)
     # single-replica reset (identical on every process) for learner init
     one_traffic = generate_traffic(env.sim_cfg, env.service, topo, T, seed=0)
     _, one_obs = env.reset(jax.random.PRNGKey(args.seed), topo, one_traffic)
@@ -148,7 +165,7 @@ def main():
     if pid == 0:
         print(json.dumps({
             "replicas": B, "episodes": args.episodes, "episode_steps": T,
-            "processes": n_proc,
+            "processes": n_proc, "sample_mode": sample_mode,
             "first_k_return": round(sum(returns[:k]) / k, 3),
             "last_k_return": round(sum(returns[-k:]) / k, 3),
             "first_k_succ": round(sum(succ[:k]) / k, 4),
