@@ -296,7 +296,7 @@ def worker(replicas: int, chunk: int, episodes: int,
         lambda *xs: jnp.stack(xs),
         *[generate_traffic(env.sim_cfg, env.service, topo, EPISODE_STEPS,
                            seed=s) for s in range(B)])
-    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True)
 
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)

@@ -176,6 +176,10 @@ class SimConfig:
     admission_iters: int = 3
     # Rank levels for exact sequential WRR among same-substep collisions.
     wrr_rank_levels: int = 4
+    # lax.scan unroll factor for the substep loop: >1 trades compile time
+    # (and a run_duration/dt divisibility requirement) for less scan
+    # overhead on a substep made of many small fusions.
+    scan_unroll: int = 1
 
     def __post_init__(self):
         if self.use_states and len(self.states) != 2:

@@ -33,7 +33,7 @@ class ParallelDDPG:
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
                  num_replicas: int, gnn_impl: str = None,
                  per_replica_topology: bool = False,
-                 sample_mode: str = "across"):
+                 sample_mode: str = "across", donate: bool = False):
         if sample_mode not in ("across", "local"):
             raise ValueError(f"unknown sample_mode {sample_mode!r}")
         self.env = env
@@ -41,6 +41,19 @@ class ParallelDDPG:
         self.B = num_replicas
         self.sample_mode = sample_mode
         self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl)
+        # ``donate=True`` aliases the replay shards into the rollout call,
+        # so XLA appends transitions to the multi-GB replay in place
+        # instead of copying it every chunk call.  Only the buffers are
+        # donated: other carried pytrees legitimately share device buffers
+        # (target params alias params at init; obs leaves can alias env
+        # state), which XLA rejects as double donation.  Callers must
+        # treat the passed-in buffers as CONSUMED (always rebind from the
+        # return) — the training loops do; comparison-style double-calls
+        # on the same inputs must keep the default.
+        if donate:
+            self.rollout_episodes = partial(
+                jax.jit(type(self).rollout_episodes.__wrapped__,
+                        static_argnums=(0, 8), donate_argnums=(2,)), self)
         # With per_replica_topology, ``topo`` arguments carry a leading [B]
         # axis (build with topology.stack_topologies) and every replica
         # trains on its own network — topology-generalization pressure in

@@ -24,6 +24,34 @@ jax.config.update("jax_platforms", "cpu")
 # exact float32 matmuls so implementation-parity tests compare numerics,
 # not matmul precision modes
 jax.config.update("jax_default_matmul_precision", "highest")
+# persistent compilation cache: the suite is compile-bound on this 1-core
+# CI box (~16 min cold), and most programs are identical run to run —
+# repeat runs skip those compiles.  Harmless if the backend declines.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _propagate_package_logs():
+    """caplog captures via root-logger propagation, which setup_logging
+    turns off for the ``gsc_tpu`` tree (console handler instead).  Tests
+    run in any order, so re-enable propagation around each test — without
+    this, any test using caplog on package loggers passes in isolation
+    and fails after whichever test calls setup_logging."""
+    import logging
+
+    logger = logging.getLogger("gsc_tpu")
+    old = logger.propagate
+    logger.propagate = True
+    yield
+    logger.propagate = old
 
 
 @pytest.fixture(autouse=True, scope="module")

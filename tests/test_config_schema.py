@@ -69,7 +69,19 @@ def test_every_field_has_a_consumer(cls):
         f"{dead} — wire them or delete them")
 
 
-def test_resource_function_plugins(tmp_path, caplog):
+@pytest.fixture
+def _registry_snapshot():
+    """Plugin registration is process-global; snapshot/restore so no other
+    test's unknown-id/fallback assertions depend on execution order."""
+    from gsc_tpu.config import registry
+
+    saved = dict(registry._RESOURCE_FUNCTIONS)
+    yield
+    registry._RESOURCE_FUNCTIONS.clear()
+    registry._RESOURCE_FUNCTIONS.update(saved)
+
+
+def test_resource_function_plugins(tmp_path, caplog, _registry_snapshot):
     """User resource-function plugins load from a path and resolve in the
     service catalog; unknown ids fall back to default with a warning
     (reference: reader.py:60-72, 99-104) — and a YAML naming a plugin

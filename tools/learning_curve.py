@@ -125,7 +125,7 @@ def main():
     # mode so curves are never compared across semantics unknowingly
     sample_mode = args.sample_mode or ("local" if multihost else "across")
     pddpg = ParallelDDPG(env, agent, num_replicas=B,
-                         sample_mode=sample_mode)
+                         sample_mode=sample_mode, donate=True)
     # single-replica reset (identical on every process) for learner init
     one_traffic = generate_traffic(env.sim_cfg, env.service, topo, T, seed=0)
     _, one_obs = env.reset(jax.random.PRNGKey(args.seed), topo, one_traffic)
