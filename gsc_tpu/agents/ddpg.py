@@ -236,8 +236,9 @@ class DDPG:
 
         zero = {"critic_loss": jnp.zeros(()), "actor_loss": jnp.zeros(()),
                 "q_values": jnp.zeros(())}
-        state, metrics = jax.lax.fori_loop(
-            0, self.agent.episode_steps, body, (state, zero))
+        n_steps = (self.agent.learn_steps if self.agent.learn_steps
+                   is not None else self.agent.episode_steps)
+        state, metrics = jax.lax.fori_loop(0, n_steps, body, (state, zero))
         return state.replace(rng=rng), metrics
 
     @partial(jax.jit, static_argnums=0)

@@ -254,6 +254,12 @@ class AgentConfig:
     target_model_update: float = 1e-4
     learning_rate: float = 1e-3
     batch_size: int = 100
+    # gradient steps per end-of-episode learn burst; None = episode_steps
+    # (the reference's train-at-episode-end schedule, simple_ddpg.py:
+    # 307-325).  A sweep knob: large-B replica runs gather B x
+    # episode_steps transitions per episode, so the reference's burst
+    # length under-trains relative to data collected.
+    learn_steps: Optional[int] = None
 
     # action post-processing (reference: simple_ddpg.py:130-131)
     schedule_threshold: float = 0.1
@@ -278,6 +284,10 @@ class AgentConfig:
         if self.objective == "prio-flow" and self.target_success != "auto":
             if not 0 <= float(self.target_success) <= 1:
                 raise ValueError("target_success must be in [0,1] or 'auto'")
+        if self.learn_steps is not None and self.learn_steps < 1:
+            # 0 would silently run zero gradient steps per learn burst;
+            # use None (= episode_steps) for the reference schedule
+            raise ValueError("learn_steps must be >= 1 (or None)")
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,50 @@
+"""Chunked-episode measurement harness shared by the throughput/quality
+tools (tools/learning_curve.py, tools/quality_sweep.py).
+
+Episodes execute as several shorter ``rollout_episodes`` device calls
+(the TPU operating mode — see ParallelDDPG.rollout_episodes) with the
+end-of-episode learn burst, and per-episode metrics are aggregated over
+ALL chunks: ``episodic_return`` sums across chunks and the success ratio
+averages them — a single chunk's stats cover only that chunk's steps, so
+reading the last chunk would score episodes on an end-of-episode slice.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
+                         state, buffers, episodes: int, episode_steps: int,
+                         chunk: int, seed: int,
+                         on_episode: Optional[Callable] = None
+                         ) -> Tuple[object, object, list, list]:
+    """Train for ``episodes`` full episodes; returns
+    (state, buffers, per-episode returns, per-episode success ratios).
+
+    ``episode_traffic(ep)`` supplies the [B]-stacked TrafficSchedule for
+    episode ``ep``; ``on_episode(ep, ret, succ, learn_metrics)`` is called
+    after each episode's learn burst."""
+    assert episode_steps % chunk == 0, (episode_steps, chunk)
+    returns, succ = [], []
+    for ep in range(episodes):
+        traffic = episode_traffic(ep)
+        env_states, obs = pddpg.reset_all(
+            jax.random.fold_in(jax.random.PRNGKey(seed + 2), ep),
+            topo, traffic)
+        ep_ret = 0.0
+        ep_succ = []
+        for c in range(episode_steps // chunk):
+            start = jnp.int32(ep * episode_steps + c * chunk)
+            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
+                state, buffers, env_states, obs, topo, traffic, start, chunk)
+            ep_ret += float(stats["episodic_return"])
+            ep_succ.append(float(stats["mean_succ_ratio"]))
+        state, metrics = pddpg.learn_burst(state, buffers)
+        returns.append(ep_ret)
+        succ.append(sum(ep_succ) / len(ep_succ))
+        if on_episode is not None:
+            on_episode(ep, ep_ret, succ[-1], metrics)
+    return state, buffers, returns, succ

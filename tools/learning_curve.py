@@ -135,32 +135,23 @@ def main():
         one_obs, num_replicas=B_local if multihost else None))
     traffic = episode_traffic(0)
 
-    returns, succ = [], []
+    from gsc_tpu.parallel.harness import run_chunked_episodes
+
     t0 = time.time()
+
+    def log_episode(ep, r, s, metrics):
+        if pid == 0:
+            print(f"episode={ep} return={r:.3f} succ={s:.3f} "
+                  f"critic_loss={float(metrics['critic_loss']):.4f} "
+                  f"elapsed={time.time() - t0:.0f}s", file=sys.stderr)
+
     with mesh_ctx:
-        for ep in range(args.episodes):
-            # fresh per-episode traffic like the trainer (device resample
-            # by default: no host->device flow-tensor transfer between
-            # episodes); episode 0 reuses the pre-loop sample
-            if ep:
-                traffic = episode_traffic(ep)
-            env_states, obs = pddpg.reset_all(
-                jax.random.fold_in(jax.random.PRNGKey(args.seed + 2), ep),
-                topo, traffic)
-            for c in range(T // chunk):
-                start = jnp.int32(ep * T + c * chunk)
-                state, buffers, env_states, obs, stats = \
-                    pddpg.rollout_episodes(state, buffers, env_states, obs,
-                                           topo, traffic, start, chunk)
-            state, metrics = pddpg.learn_burst(state, buffers)
-            r = float(stats["episodic_return"])
-            s = float(stats["mean_succ_ratio"])
-            returns.append(r)
-            succ.append(s)
-            if pid == 0:
-                print(f"episode={ep} return={r:.3f} succ={s:.3f} "
-                      f"critic_loss={float(metrics['critic_loss']):.4f} "
-                      f"elapsed={time.time() - t0:.0f}s", file=sys.stderr)
+        # episode 0 reuses the pre-loop traffic sample
+        _, _, returns, succ = run_chunked_episodes(
+            pddpg, topo,
+            lambda ep: episode_traffic(ep) if ep else traffic,
+            state, buffers, args.episodes, T, chunk, args.seed,
+            on_episode=log_episode)
     k = min(10, max(1, len(returns) // 4))
     if pid == 0:
         print(json.dumps({

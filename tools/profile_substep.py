@@ -1,0 +1,124 @@
+"""Substep profiler: trace the engine's control-interval scan and rank
+fusions by self-time.
+
+The r3 perf unlocks all came from exactly this loop (trace -> aggregate ->
+kill the dominant op class); this makes it a one-command repo tool instead
+of ad-hoc /tmp scripts.  Captures a fresh jax.profiler trace of ``--calls``
+chunked rollout calls at the given replica count, parses the
+trace-events JSON (.gz) for the device track, and prints the top-K ops by
+total self duration plus the per-substep wall.
+
+    python tools/profile_substep.py --replicas 256 --chunk 50
+    python tools/profile_substep.py --cpu --replicas 4 --chunk 5  # smoke
+
+Only FRESH trace dirs are globbed (stale files double-count — r3 gotcha).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=256)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--calls", type=int, default=3)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--episode-steps", type=int, default=200)
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim.traffic_device import DeviceTraffic
+
+    T, B, chunk = args.episode_steps, args.replicas, args.chunk
+    env, agent, topo, _ = _flagship(episode_steps=T, gen_traffic=False)
+    dt = DeviceTraffic(env.sim_cfg, env.service, topo, T)
+    traffic = jax.jit(lambda k: dt.sample_batch(k, B))(jax.random.PRNGKey(0))
+    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+
+    def call(state, buffers, env_states, obs, start):
+        return pddpg.rollout_episodes(state, buffers, env_states, obs,
+                                      topo, traffic, jnp.int32(start), chunk)
+
+    # compile + warm
+    out = call(state, buffers, env_states, obs, 0)
+    jax.block_until_ready(out)
+    state, buffers, env_states, obs = out[:4]
+
+    trace_dir = tempfile.mkdtemp(prefix="substep_trace_")
+    t0 = time.time()
+    with jax.profiler.trace(trace_dir):
+        for c in range(args.calls):
+            out = call(state, buffers, env_states, obs, (c + 1) * chunk)
+            state, buffers, env_states, obs = out[:4]
+        jax.block_until_ready(out)
+    wall = time.time() - t0
+
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not files:
+        print(json.dumps({"error": "no trace written", "dir": trace_dir}))
+        return
+    agg = collections.Counter()
+    counts = collections.Counter()
+    for fp in files:
+        with gzip.open(fp, "rt") as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        # restrict to DEVICE lanes (XLA ops): host python/TSL lanes also
+        # carry dur and would otherwise pollute the ranking.  pid names
+        # come from process_name metadata events; fall back to all lanes
+        # if no device track exists (plain CPU backend).
+        dev_pids = {ev.get("pid") for ev in events
+                    if ev.get("ph") == "M"
+                    and ev.get("name") == "process_name"
+                    and any(s in str((ev.get("args") or {}).get("name", ""))
+                            .lower() for s in ("/device:", "tpu", "gpu",
+                                               "xla"))}
+        for ev in events:
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            if dev_pids and ev.get("pid") not in dev_pids:
+                continue
+            name = ev.get("name", "")
+            args_d = ev.get("args") or {}
+            key = args_d.get("long_name") or name
+            agg[key.split("(")[0][:80]] += ev["dur"]
+            counts[key.split("(")[0][:80]] += 1
+    total = sum(agg.values())
+    env_steps = args.calls * chunk * B
+    print(json.dumps({
+        "backend": jax.default_backend(), "replicas": B, "chunk": chunk,
+        "calls": args.calls, "wall_s": round(wall, 3),
+        "env_steps_per_sec": round(env_steps / wall, 1),
+        "trace_total_us": total,
+    }))
+    width = max((len(k) for k, _ in agg.most_common(args.top)), default=10)
+    for name, dur in agg.most_common(args.top):
+        print(f"{dur/1e3:10.2f} ms  {100*dur/max(total,1):5.1f}%  "
+              f"x{counts[name]:<6} {name:<{width}}")
+
+
+if __name__ == "__main__":
+    main()
