@@ -39,13 +39,13 @@ def dense_adj(edge_index: jnp.ndarray, edge_mask: jnp.ndarray,
     return one(edge_index, edge_mask, node_mask)
 
 
-def gatv2_dense(x: jnp.ndarray, adj: jnp.ndarray, w_l: jnp.ndarray,
-                b_l: jnp.ndarray, w_r: jnp.ndarray, b_r: jnp.ndarray,
-                att: jnp.ndarray, bias: jnp.ndarray,
-                mean_aggr: bool) -> jnp.ndarray:
-    """Dense masked GATv2 layer.  x: [..., N, F_in], adj: [..., N, N] bool."""
-    xl = x @ w_l + b_l                       # [..., N, F] source projection
-    xr = x @ w_r + b_r                       # [..., N, F] target projection
+def attention_dense(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
+                    bias: jnp.ndarray, adj: jnp.ndarray,
+                    mean_aggr: bool) -> jnp.ndarray:
+    """The attention STAGE on already-projected features (xl/xr:
+    [..., N, F]) — the math the Pallas kernel fuses, and the backward pass
+    it borrows (pallas_gat.py defines the kernel's custom VJP through this
+    function)."""
     e = xl[..., None, :, :] + xr[..., :, None, :]   # [..., i, j, F]
     e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
     logits = jnp.einsum("...ijf,f->...ij", e, att)
@@ -60,6 +60,16 @@ def gatv2_dense(x: jnp.ndarray, adj: jnp.ndarray, w_l: jnp.ndarray,
         out = out / jnp.maximum(deg, 1)
     has_nbr = adj.any(axis=-1, keepdims=True)
     return jnp.where(has_nbr, out + bias, 0.0)
+
+
+def gatv2_dense(x: jnp.ndarray, adj: jnp.ndarray, w_l: jnp.ndarray,
+                b_l: jnp.ndarray, w_r: jnp.ndarray, b_r: jnp.ndarray,
+                att: jnp.ndarray, bias: jnp.ndarray,
+                mean_aggr: bool) -> jnp.ndarray:
+    """Dense masked GATv2 layer.  x: [..., N, F_in], adj: [..., N, N] bool."""
+    xl = x @ w_l + b_l                       # [..., N, F] source projection
+    xr = x @ w_r + b_r                       # [..., N, F] target projection
+    return attention_dense(xl, xr, att, bias, adj, mean_aggr)
 
 
 def gatv2_segment(x: jnp.ndarray, edge_index: jnp.ndarray,

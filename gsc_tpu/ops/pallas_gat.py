@@ -60,9 +60,10 @@ def _gat_kernel(xl_ref, xr_ref, att_ref, bias_ref, adj_ref, out_ref, *,
 
 
 @functools.partial(jax.jit, static_argnames=("mean_aggr", "tile_b", "interpret"))
-def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
-                 bias: jnp.ndarray, adj: jnp.ndarray, mean_aggr: bool = True,
-                 tile_b: int = 8, interpret: bool | None = None) -> jnp.ndarray:
+def _gatv2_pallas_impl(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
+                       bias: jnp.ndarray, adj: jnp.ndarray,
+                       mean_aggr: bool = True, tile_b: int = 8,
+                       interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention stage.  xl/xr: [..., N, F] projected features,
     adj: [..., N, N] bool.  Leading dims are flattened into the graph batch;
     a single graph (no leading dim) is supported too."""
@@ -98,3 +99,44 @@ def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
         interpret=interpret,
     )(xl3, xr3, att, bias, adj3)
     return out[:b].reshape(*lead, n, f)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
+                 bias: jnp.ndarray, adj: jnp.ndarray, mean_aggr: bool = True,
+                 tile_b: int = 8, interpret: bool | None = None) -> jnp.ndarray:
+    """Fused attention stage with a custom VJP.
+
+    Pallas kernels define no autodiff rule, so without this the learn
+    path (actor/critic gradients through the GNN) cannot use
+    ``gnn_impl="pallas"`` at all.  Forward runs the fused kernel;
+    backward differentiates the mathematically identical dense
+    formulation (``ops.gat.attention_dense`` — the bit-parity reference
+    this kernel is tested against), so gradients equal the dense path's
+    exactly while the forward still skips the [B, N, N, F] HBM
+    intermediate."""
+    return _gatv2_pallas_impl(xl, xr, att, bias, adj, mean_aggr, tile_b,
+                              interpret)
+
+
+def _gatv2_pallas_fwd(xl, xr, att, bias, adj, mean_aggr, tile_b, interpret):
+    out = _gatv2_pallas_impl(xl, xr, att, bias, adj, mean_aggr, tile_b,
+                             interpret)
+    return out, (xl, xr, att, bias, adj)
+
+
+def _gatv2_pallas_bwd(mean_aggr, tile_b, interpret, res, g):
+    import numpy as np
+
+    from .gat import attention_dense
+
+    xl, xr, att, bias, adj = res
+    _, vjp = jax.vjp(
+        lambda xl_, xr_, att_, bias_: attention_dense(
+            xl_, xr_, att_, bias_, adj, mean_aggr), xl, xr, att, bias)
+    d_xl, d_xr, d_att, d_bias = vjp(g)
+    d_adj = np.zeros(adj.shape, dtype=jax.dtypes.float0)  # bool primal
+    return d_xl, d_xr, d_att, d_bias, d_adj
+
+
+gatv2_pallas.defvjp(_gatv2_pallas_fwd, _gatv2_pallas_bwd)

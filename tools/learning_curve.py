@@ -147,7 +147,7 @@ def main():
 
     with mesh_ctx:
         # episode 0 reuses the pre-loop traffic sample
-        _, _, returns, succ = run_chunked_episodes(
+        _, _, returns, succ, final_succ = run_chunked_episodes(
             pddpg, topo,
             lambda ep: episode_traffic(ep) if ep else traffic,
             state, buffers, args.episodes, T, chunk, args.seed,
@@ -161,6 +161,8 @@ def main():
             "last_k_return": round(sum(returns[-k:]) / k, 3),
             "first_k_succ": round(sum(succ[:k]) / k, 4),
             "last_k_succ": round(sum(succ[-k:]) / k, 4),
+            "first_k_final_succ": round(sum(final_succ[:k]) / k, 4),
+            "last_k_final_succ": round(sum(final_succ[-k:]) / k, 4),
             "wall_s": round(time.time() - t0, 1),
         }))
 

@@ -54,7 +54,7 @@ def run_cell(args, lr, sigma, learn_steps, seed):
     from gsc_tpu.parallel.harness import run_chunked_episodes
 
     t0 = time.time()
-    _, _, returns, succ = run_chunked_episodes(
+    _, _, returns, succ, final_succ = run_chunked_episodes(
         pddpg, topo,
         lambda ep: sample_batch(jax.random.fold_in(
             jax.random.PRNGKey(seed + 3), ep)),
@@ -68,6 +68,9 @@ def run_cell(args, lr, sigma, learn_steps, seed):
         "last_k_return": round(sum(returns[-k:]) / k, 3),
         "first_k_succ": round(sum(succ[:k]) / k, 4),
         "last_k_succ": round(sum(succ[-k:]) / k, 4),
+        # end-of-episode slice — the number the ">= 0.64" bar refers to
+        "first_k_final_succ": round(sum(final_succ[:k]) / k, 4),
+        "last_k_final_succ": round(sum(final_succ[-k:]) / k, 4),
         "env_steps_per_sec_wall": round(
             args.episodes * T * B / wall, 1),
         "wall_s": round(wall, 1),
@@ -97,19 +100,28 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
+    # a "cell" includes the run shape, so re-sweeping at a different
+    # replica count / length into the same file collects fresh data
+    # instead of skipping everything
+    def cell_key(lr, sigma, learn_steps):
+        return (lr, sigma, learn_steps, args.replicas, args.episodes,
+                args.episode_steps)
+
     done = set()
     if os.path.exists(args.out):
         for line in open(args.out):
             try:
                 r = json.loads(line)
-                done.add((r["lr"], r["sigma"], r["learn_steps"]))
+                done.add((r["lr"], r["sigma"], r["learn_steps"],
+                          r["replicas"], r["episodes"], r["episode_steps"]))
             except (json.JSONDecodeError, KeyError):
                 continue
     cells = list(itertools.product(args.grid_lr, args.grid_sigma,
                                    args.grid_learn_steps))
     for lr, sigma, ls in cells:
         ls_eff = None if ls == 0 else ls
-        if (lr, sigma, ls_eff) in done or (lr, sigma, ls) in done:
+        if cell_key(lr, sigma, ls_eff) in done \
+                or cell_key(lr, sigma, ls) in done:
             print(f"[sweep] skip done cell lr={lr} sigma={sigma} "
                   f"learn_steps={ls}", file=sys.stderr)
             continue
