@@ -209,3 +209,73 @@ def test_oracle_numbers_are_current(name):
     assert out["dropped_by_reason"] == want["drop_reasons"]
     assert out["avg_end2end_delay"] == pytest.approx(want["avg_e2e"],
                                                      rel=1e-9)
+
+
+# ---------------------------------------------------------------- per-flow
+# FlowController (per-flow external decisions) parity: local-processing
+# policy on the line3-egress asset — place-on-decision, the per-flow
+# decision loop, and egress routing, vs the reference's FlowController +
+# ExternalDecisionMaker driven by tools/run_reference.py --mode perflow.
+# Frozen reference output (duration 2000, seed 1234): generated 201
+# (the reference also books the boundary arrival at t == horizon),
+# processed 197, dropped 0, avg e2e 35.0 (3 x 5 ms SFs + 20 ms path).
+PERFLOW = {
+    "network": os.path.join(REPO, "tests", "assets", "line3-egress.graphml"),
+    "config": os.path.join(REPO, "tests", "assets", "perflow_config.yaml"),
+    "duration": 2000,
+    "generated": 201, "processed": 197, "dropped": 0,
+    "avg_e2e": 35.0,
+}
+
+
+def test_perflow_engine_matches_reference():
+    import jax.numpy as jnp
+
+    from gsc_tpu.config.loader import load_service, load_sim
+    from gsc_tpu.config.schema import EnvLimits
+    from gsc_tpu.sim.engine import SimEngine
+    from gsc_tpu.sim.state import PH_DECIDE
+    from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.topology.compiler import load_topology
+
+    svc = load_service(os.path.join(REFERENCE, SERVICE))
+    sim_cfg = load_sim(PERFLOW["config"])
+    assert sim_cfg.controller == "per_flow"   # loader maps FlowController
+    limits = EnvLimits.for_service(svc, max_nodes=8, max_edges=8)
+    topo = load_topology(PERFLOW["network"], max_nodes=8, max_edges=8)
+    steps = PERFLOW["duration"] // int(sim_cfg.run_duration)
+    traffic = generate_traffic(sim_cfg, svc, topo, steps, SEED)
+    engine = SimEngine(svc, sim_cfg, limits)
+
+    def decide_local(st):
+        return jnp.where(st.flows.phase == PH_DECIDE, st.flows.node, -1)
+
+    state = engine.init(jax.random.PRNGKey(SEED), topo)
+    for _ in range(steps):
+        state, metrics = engine.apply_per_flow(state, topo, traffic,
+                                               decide_local)
+    assert abs(int(metrics.generated) - PERFLOW["generated"]) <= 2
+    assert int(metrics.processed) == PERFLOW["processed"]
+    assert int(metrics.dropped) == PERFLOW["dropped"]
+    assert float(metrics.avg_e2e()) == pytest.approx(PERFLOW["avg_e2e"],
+                                                     rel=1e-6)
+
+
+def test_perflow_oracle_numbers_are_current():
+    """Re-run the reference FlowController itself and verify the frozen
+    constants."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "run_reference.py"),
+         "--mode", "perflow", "--network", PERFLOW["network"],
+         "--config", PERFLOW["config"],
+         "--duration", str(PERFLOW["duration"]), "--seed", str(SEED)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["generated_flows"] == PERFLOW["generated"]
+    assert out["processed_flows"] == PERFLOW["processed"]
+    assert out["dropped_flows"] == PERFLOW["dropped"]
+    assert out["avg_end2end_delay"] == pytest.approx(PERFLOW["avg_e2e"],
+                                                     rel=1e-9)

@@ -161,3 +161,16 @@ class Environment:
                 return None
             self.step()
         return None
+
+
+class _EventsNamespace:
+    """``simpy.events`` compatibility: the reference's
+    ExternalDecisionMaker introspects ``simpy.events.Event`` when scanning
+    the queue for same-instant scheduling conflicts
+    (external_decision_maker.py:33-41)."""
+
+    Event = Event
+    Timeout = Timeout
+
+
+events = _EventsNamespace

@@ -78,6 +78,19 @@ def _install_shim():
     sys.path.insert(0, REFERENCE)
 
 
+def _metrics_dict(m):
+    """Common metrics extraction shared by every mode."""
+    return {
+        "generated_flows": int(m["generated_flows"]),
+        "processed_flows": int(m["processed_flows"]),
+        "dropped_flows": int(m["dropped_flows"]),
+        "total_active_flows": int(m["total_active_flows"]),
+        "avg_end2end_delay": float(m["avg_end2end_delay"]),
+        "dropped_by_reason": {k: int(v) for k, v in
+                              m["dropped_flow_reasons"].items()},
+    }
+
+
 def uniform_action(network, sfc_list, sf_list):
     """Uniform schedule + place-everything action, the same 'dummy agent'
     our cli simulate uses (spinterface SimulatorAction schema:
@@ -109,7 +122,6 @@ def run_interface(network_file, service_file, config_file, steps, seed):
     for _ in range(steps):
         sim.apply(action)
     apply_s = time.time() - t0
-    m = sim.params.metrics.metrics
     out = {
         "mode": "interface",
         "network": network_file,
@@ -119,15 +131,50 @@ def run_interface(network_file, service_file, config_file, steps, seed):
         "init_wall_s": round(init_s, 4),
         "apply_wall_s": round(apply_s, 4),
         "steps_per_sec": round(steps / apply_s, 2) if apply_s else None,
-        "generated_flows": int(m["generated_flows"]),
-        "processed_flows": int(m["processed_flows"]),
-        "dropped_flows": int(m["dropped_flows"]),
-        "total_active_flows": int(m["total_active_flows"]),
-        "avg_end2end_delay": float(m["avg_end2end_delay"]),
-        "dropped_by_reason": {k: int(v) for k, v in
-                              m["dropped_flow_reasons"].items()},
+        **_metrics_dict(sim.params.metrics.metrics),
     }
     return out
+
+
+def run_perflow(network_file, service_file, config_file, duration, seed):
+    """FlowController (per-flow external decisions) loop: init, then apply
+    a decision per presented flow — policy: always process at the flow's
+    CURRENT node (the same local-processing policy the rebuild's
+    ``cli simulate`` uses in per_flow mode) — until sim time reaches
+    ``duration``.  coordsim/controller/flow_controller.py:21-92."""
+    from siminterface import Simulator
+
+    sim = Simulator(os.path.join(REFERENCE, network_file),
+                    os.path.join(REFERENCE, service_file),
+                    os.path.join(REFERENCE, config_file),
+                    test_mode=False)
+    state = sim.init(seed)
+    decisions = 0
+    t0 = time.time()
+    while float(sim.env.now) < duration:
+        flow = state.flow
+
+        class _A:  # duck-typed per-flow action (.flow, .destination_node_id)
+            pass
+
+        a = _A()
+        a.flow = flow
+        # local processing; completed flows are routed toward their egress
+        # (a same-node decision for a to-eg flow only burns 1 ms of TTL,
+        # flowsimulator.py:93-97)
+        a.destination_node_id = (flow.egress_node_id
+                                 if getattr(flow, "forward_to_eg", False)
+                                 and flow.egress_node_id is not None
+                                 else flow.current_node_id)
+        state = sim.apply(a)
+        decisions += 1
+    wall = time.time() - t0
+    return {
+        "mode": "perflow", "network": network_file, "duration": duration,
+        "seed": seed, "decisions": decisions, "wall_s": round(wall, 4),
+        "sim_now": float(sim.env.now),
+        **_metrics_dict(sim.params.metrics.metrics),
+    }
 
 
 def run_standalone(network_file, service_file, config_file, duration, seed):
@@ -177,7 +224,7 @@ def run_standalone(network_file, service_file, config_file, duration, seed):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["interface", "standalone"],
+    ap.add_argument("--mode", choices=["interface", "standalone", "perflow"],
                     default="interface")
     ap.add_argument("--network",
                     default="configs/networks/triangle/"
@@ -196,6 +243,9 @@ def main():
     if args.mode == "interface":
         out = run_interface(args.network, args.service, args.config,
                             args.steps, args.seed)
+    elif args.mode == "perflow":
+        out = run_perflow(args.network, args.service, args.config,
+                          args.duration, args.seed)
     else:
         out = run_standalone(args.network, args.service, args.config,
                              args.duration, args.seed)
