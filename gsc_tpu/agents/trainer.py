@@ -165,6 +165,98 @@ class Trainer:
             self.tb.close()
         return state, buffer
 
+    def train_parallel(self, episodes: int, num_replicas: int,
+                       chunk: int = 50, verbose: bool = False,
+                       device_traffic: bool = True, profile: bool = False):
+        """Replica-parallel training: B vmapped env replicas per episode on
+        the scheduled topology, chunked rollouts + end-of-episode learn
+        burst (the bench/learning-curve path), logged through the same
+        rewards.csv/history machinery as ``train``.  Per-episode traffic is
+        sampled ON DEVICE by default (one DeviceTraffic sampler per
+        distinct scheduled topology).  Returns (state, buffers).
+
+        The reference has no analogue (one process, one env); evaluation
+        and checkpointing consume the resulting learner state exactly like
+        the single-env path's."""
+        if profile and self.result_dir:
+            from ..utils.debug import Profiler
+            with Profiler(os.path.join(self.result_dir, "profile")):
+                return self.train_parallel(episodes, num_replicas, chunk,
+                                           verbose, device_traffic,
+                                           profile=False)
+        from ..parallel import ParallelDDPG
+        from ..parallel.harness import run_chunked_episodes
+        from ..sim.traffic_device import DeviceTraffic
+
+        steps_per_ep = self.agent_cfg.episode_steps
+        if steps_per_ep % chunk != 0:
+            # never silently upgrade to a single full-episode scan — that
+            # is exactly the call shape the chunking exists to avoid
+            raise ValueError(
+                f"chunk ({chunk}) must divide episode_steps "
+                f"({steps_per_ep})")
+        pddpg = ParallelDDPG(self.env, self.agent_cfg,
+                             num_replicas=num_replicas, donate=True,
+                             gnn_impl=self.ddpg.actor.gnn_impl)
+        base = jax.random.PRNGKey(self.seed)
+
+        topo0, traffic0 = self.driver.episode(0, False)
+        _, one_obs = self.env.reset(jax.random.fold_in(base, 1000), topo0,
+                                    traffic0)
+        state = pddpg.init(jax.random.fold_in(base, 0), one_obs)
+        buffers = pddpg.init_buffers(one_obs)
+
+        # one on-device sampler per scheduled topology (the scheduler
+        # cycles training_network_files every `period` episodes)
+        samplers = {}
+
+        def episode_traffic(ep, topo):
+            if not device_traffic:
+                stacked = [self.driver.traffic_for(
+                    ep, topo, seed=self.driver.base_seed + 1000 * ep + r)
+                    for r in range(num_replicas)]
+                return jax.tree_util.tree_map(
+                    lambda *xs: jax.numpy.stack(xs), *stacked)
+            # key by the topology OBJECT the episode actually uses — the
+            # driver owns the schedule; re-deriving its index here would
+            # duplicate that invariant
+            if id(topo) not in samplers:
+                samplers[id(topo)] = DeviceTraffic(
+                    self.env.sim_cfg, self.env.service, topo, steps_per_ep,
+                    trace=self.driver.trace, capacity=self.driver.capacity)
+            return samplers[id(topo)].sample_batch(
+                jax.random.fold_in(base, 2000 + ep), num_replicas)
+
+        start = time.time()
+        # the scheduler may swap topologies mid-run, so drive the harness
+        # one episode at a time with that episode's topology — passing the
+        # GLOBAL step offset so the agent's warmup schedule sees one
+        # continuous run
+        for ep in range(episodes):
+            topo = self.driver.topology_for(ep)
+            traffic = episode_traffic(ep, topo)
+            state, buffers, rets, succ, final = run_chunked_episodes(
+                pddpg, topo, lambda _: traffic, state, buffers,
+                1, steps_per_ep, chunk, self.seed + ep,
+                step_offset=ep * steps_per_ep)
+            sps = ((ep + 1) * steps_per_ep * num_replicas
+                   / (time.time() - start))
+            row = {"episodic_return": rets[0], "mean_succ_ratio": succ[0],
+                   "final_succ_ratio": final[0], "episode": ep, "sps": sps}
+            self.history.append(row)
+            self.rewards_writer.write(rets[0])
+            if self.tb:
+                gs = (ep + 1) * steps_per_ep
+                self.tb.add_scalar("charts/episodic_return", rets[0], gs)
+                self.tb.add_scalar("charts/SPS", sps, gs)
+            if verbose:
+                log.info("episode=%d return=%.3f succ=%.3f sps=%.1f",
+                         ep, rets[0], succ[0], sps)
+        self.rewards_writer.close()
+        if self.tb:
+            self.tb.close()
+        return state, buffers
+
     def evaluate(self, state: DDPGState, episodes: int = 1,
                  test_mode: bool = True, telemetry: bool = False,
                  write_schedule: bool = False) -> Dict[str, float]:

@@ -186,13 +186,24 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
               help="dir (or .py file) of user resource-function plugins "
                    "to register before parsing the service catalog "
                    "(reference: reader.py:60-72 dynamic imports)")
+@click.option("--replicas", default=1, show_default=True,
+              help="vmapped env replicas per episode (>1: the TPU "
+                   "data-parallel path with on-device per-episode traffic "
+                   "sampling; 1: the reference's single-env loop)")
+@click.option("--chunk", default=50, show_default=True,
+              help="rollout steps per device call with --replicas > 1 "
+                   "(long single-call scans exceed TPU per-call limits)")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
-          profile, runs, resume, resource_functions_path, verbose):
+          profile, runs, resume, resource_functions_path, replicas, chunk,
+          verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
-    (src/rlsp/agents/main.py:89-113 semantics)."""
+    (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
+    episode rolls out B vmapped env replicas feeding sharded replay — the
+    TPU scale-out the reference lacks; evaluation and the checkpointed
+    learner state are identical in shape to the single-env path."""
     import numpy as _np
 
     from .agents.trainer import Trainer
@@ -228,6 +239,10 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                           tensorboard=tensorboard)
         init_state = init_buffer = None
         start_episode = 0
+        if resume and replicas > 1:
+            raise click.BadParameter(
+                "--resume with --replicas > 1 is not supported yet "
+                "(replica-sharded replay has a different storage shape)")
         if resume:
             from .utils.checkpoint import load_full_or_partial
             topo0, traffic0 = driver.episode(0, False)
@@ -250,10 +265,16 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             start_episode = int(restored["extra"]["episode"]) \
                 if "extra" in restored else 0
         result.runtime_start("train")
-        state, buffer = trainer.train(episodes, verbose=verbose,
-                                      profile=profile, init_state=init_state,
-                                      init_buffer=init_buffer,
-                                      start_episode=start_episode)
+        if replicas > 1:
+            state, buffer = trainer.train_parallel(
+                episodes, num_replicas=replicas, chunk=chunk,
+                verbose=verbose, profile=profile)
+        else:
+            state, buffer = trainer.train(episodes, verbose=verbose,
+                                          profile=profile,
+                                          init_state=init_state,
+                                          init_buffer=init_buffer,
+                                          start_episode=start_episode)
         result.runtime_stop("train")
 
         ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,

@@ -19,8 +19,9 @@ import jax.numpy as jnp
 def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                          state, buffers, episodes: int, episode_steps: int,
                          chunk: int, seed: int,
-                         on_episode: Optional[Callable] = None
-                         ) -> Tuple[object, object, list, list]:
+                         on_episode: Optional[Callable] = None,
+                         step_offset: int = 0
+                         ) -> Tuple[object, object, list, list, list]:
     """Train for ``episodes`` full episodes; returns (state, buffers,
     per-episode returns, per-episode MEAN success ratios, per-episode
     FINAL-step success ratios).  The mean averages every step of the
@@ -30,7 +31,14 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
 
     ``episode_traffic(ep)`` supplies the [B]-stacked TrafficSchedule for
     episode ``ep``; ``on_episode(ep, ret, succ, learn_metrics)`` is called
-    after each episode's learn burst."""
+    after each episode's learn burst.
+
+    ``step_offset`` is the GLOBAL step of this call's first rollout step —
+    callers that drive the harness one episode at a time (e.g.
+    Trainer.train_parallel) must pass ``ep * episode_steps``, or the
+    agent's warmup gate (global_step < nb_steps_warmup_critic selects
+    random actions) would restart at 0 every episode and the policy would
+    never act."""
     assert episode_steps % chunk == 0, (episode_steps, chunk)
     returns, succ, final_succ = [], [], []
     for ep in range(episodes):
@@ -40,7 +48,7 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
             topo, traffic)
         chunk_stats = []
         for c in range(episode_steps // chunk):
-            start = jnp.int32(ep * episode_steps + c * chunk)
+            start = jnp.int32(step_offset + ep * episode_steps + c * chunk)
             state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
                 state, buffers, env_states, obs, topo, traffic, start, chunk)
             chunk_stats.append(stats)   # device scalars: convert AFTER the

@@ -222,18 +222,13 @@ def _example_obs(trainer):
     return obs
 
 
-def test_cli_train_resume_roundtrip(tmp_path):
-    """cli train --resume continues a checkpointed run end-to-end, and cli
-    infer restores the resulting full checkpoint."""
-    import json
-
+def write_tiny_configs(cfg):
+    """Minimal triangle config quadruple for CLI tests; returns the common
+    argument list."""
     import yaml
-    from click.testing import CliRunner
 
-    from gsc_tpu.cli import cli as cli_group
     from gsc_tpu.topology.synthetic import triangle, write_graphml
 
-    cfg = tmp_path
     write_graphml(triangle(), str(cfg / "tri.graphml"))
     yaml.safe_dump({
         "sfc_list": {"sfc_1": ["a", "b", "c"]},
@@ -256,9 +251,22 @@ def test_cli_train_resume_roundtrip(tmp_path):
         "training_network_files": [str(cfg / "tri.graphml")],
         "inference_network": str(cfg / "tri.graphml"),
     }, open(cfg / "sched.yaml", "w"))
-    args = [str(cfg / "agent.yaml"), str(cfg / "sim.yaml"),
+    return [str(cfg / "agent.yaml"), str(cfg / "sim.yaml"),
             str(cfg / "svc.yaml"), str(cfg / "sched.yaml"),
             "--max-nodes", "8", "--max-edges", "8", "--quiet"]
+
+
+def test_cli_train_resume_roundtrip(tmp_path):
+    """cli train --resume continues a checkpointed run end-to-end, and cli
+    infer restores the resulting full checkpoint."""
+    import json
+
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli as cli_group
+
+    cfg = tmp_path
+    args = write_tiny_configs(cfg)
     r1 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "2",
                                         "--result-dir", str(cfg / "res1")])
     assert r1.exit_code == 0, (r1.output, r1.exception)
@@ -297,6 +305,35 @@ def test_cli_train_resume_roundtrip(tmp_path):
                                         "--resume", so_path])
     assert r4.exit_code == 0, (r4.output, r4.exception)
     assert "replay buffer not restorable" in r4.output
+
+
+def test_cli_train_replicas(tmp_path):
+    """cli train --replicas B: the replica-parallel path through the USER
+    surface — trains, writes rewards.csv, checkpoints a learner state the
+    single-env infer path restores."""
+    import csv
+    import json
+    import os
+
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli as cli_group
+
+    args = write_tiny_configs(tmp_path)
+    r = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "2",
+                                       "--replicas", "2", "--chunk", "3",
+                                       "--result-dir",
+                                       str(tmp_path / "resp")])
+    assert r.exit_code == 0, (r.output, r.exception)
+    out = json.loads(r.output.strip().splitlines()[-1])
+    with open(os.path.join(out["result_dir"], "rewards.csv")) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 3  # header + 2 episodes
+    r2 = CliRunner().invoke(cli_group, ["infer", *args[:4],
+                                        out["checkpoint"],
+                                        "--max-nodes", "8",
+                                        "--max-edges", "8"])
+    assert r2.exit_code == 0, (r2.output, r2.exception)
 
 
 def test_logging_setup(tmp_path):

@@ -194,3 +194,42 @@ def test_pallas_gnn_selectable_from_config():
     params = actor.init(jax.random.PRNGKey(1), obs)
     out = jax.jit(actor.apply)(params, obs)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_harness_global_step_offsets():
+    """run_chunked_episodes threads the GLOBAL step into every rollout
+    call: chunks advance within an episode, episodes advance within a
+    call, and step_offset shifts the whole call — so per-episode drivers
+    (Trainer.train_parallel) keep the agent's warmup schedule continuous
+    instead of restarting it at 0 each episode."""
+    import jax.numpy as jnp
+
+    from gsc_tpu.parallel.harness import run_chunked_episodes
+
+    class Spy:
+        def __init__(self):
+            self.starts = []
+
+        def reset_all(self, rng, topo, traffic):
+            return None, None
+
+        def rollout_episodes(self, state, buffers, es, obs, topo, traffic,
+                             start, chunk):
+            self.starts.append(int(start))
+            stats = {"episodic_return": jnp.float32(1.0),
+                     "mean_succ_ratio": jnp.float32(0.5),
+                     "final_succ_ratio": jnp.float32(0.5)}
+            return state, buffers, es, obs, stats
+
+        def learn_burst(self, state, buffers):
+            return state, {"critic_loss": jnp.float32(0.0)}
+
+    spy = Spy()
+    run_chunked_episodes(spy, None, lambda ep: None, None, None,
+                         episodes=2, episode_steps=4, chunk=2, seed=0)
+    assert spy.starts == [0, 2, 4, 6]
+    spy.starts.clear()
+    run_chunked_episodes(spy, None, lambda ep: None, None, None,
+                         episodes=1, episode_steps=4, chunk=2, seed=0,
+                         step_offset=8)
+    assert spy.starts == [8, 10]
