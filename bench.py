@@ -312,7 +312,7 @@ def worker(replicas: int, chunk: int, episodes: int,
 
     from __graft_entry__ import _flagship
     from gsc_tpu.parallel import ParallelDDPG
-    from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.sim.traffic_device import DeviceTraffic
 
     if scenario != "flagship" and scenario not in STACKS:
         raise SystemExit(f"unknown scenario {scenario!r} (expected "
@@ -326,10 +326,12 @@ def worker(replicas: int, chunk: int, episodes: int,
         env, agent, topo, _ = _flagship(episode_steps=EPISODE_STEPS,
                                         gen_traffic=False)
     B = replicas
-    traffic = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs),
-        *[generate_traffic(env.sim_cfg, env.service, topo, EPISODE_STEPS,
-                           seed=s) for s in range(B)])
+    # traffic sampled ON DEVICE: at B=256 the old host-stacked schedule was
+    # ~90 MB through the tunnel before the first measurement
+    dt_sampler = DeviceTraffic(env.sim_cfg, env.service, topo, EPISODE_STEPS)
+    traffic = jax.jit(lambda k: dt_sampler.sample_batch(k, B))(
+        jax.random.PRNGKey(42))
+    jax.block_until_ready(traffic)
     pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True)
 
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
