@@ -133,3 +133,28 @@ def test_batch_sampling_shapes_and_divergence():
     assert b.ingress_active.shape == (4, 4, 8)
     t = np.asarray(b.arr_time)
     assert not np.array_equal(t[0], t[1])       # per-replica streams
+
+
+def test_trace_overrides_mmpp_means():
+    """Trace rows override the MMPP chain from their timestamp on (host
+    semantics: means filled by the chain, then trace rows overwrite,
+    traffic.py:131-142) — the deactivation window must be silent even
+    though the chain keeps running."""
+    cfg = SimConfig(
+        ttl_choices=(100.0,), deterministic_arrival=True,
+        use_states=True, init_state="s0", rand_init_state=False,
+        states=(MMPPState(name="s0", inter_arr_mean=5.0, switch_p=0.5),
+                MMPPState(name="s1", inter_arr_mean=50.0, switch_p=0.5)))
+    trace = TraceEvents([(500.0, 0, None, None), (1500.0, 0, 5.0, None)])
+    dt = DeviceTraffic(cfg, service(), topo(1), episode_steps=20,
+                       trace=trace)
+    tr = jax.jit(dt.sample)(jax.random.PRNGKey(3))
+    t = np.asarray(tr.arr_time)
+    t = t[np.isfinite(t)]
+    assert not ((t >= 500.0) & (t < 1500.0)).any()   # silent window
+    assert (t < 500.0).any() and (t >= 1500.0).any()
+    # post-reactivation the overridden FIXED mean applies: dense 5 ms
+    # arrivals regardless of chain state
+    post = np.sort(t[t >= 1500.0])
+    gaps = np.diff(post)
+    assert np.allclose(gaps, 5.0)
