@@ -167,7 +167,9 @@ class Trainer:
 
     def train_parallel(self, episodes: int, num_replicas: int,
                        chunk: int = 50, verbose: bool = False,
-                       device_traffic: bool = True, profile: bool = False):
+                       device_traffic: bool = True, profile: bool = False,
+                       init_state: Optional[DDPGState] = None,
+                       init_buffers=None, start_episode: int = 0):
         """Replica-parallel training: B vmapped env replicas per episode on
         the scheduled topology, chunked rollouts + end-of-episode learn
         burst (the bench/learning-curve path), logged through the same
@@ -183,7 +185,10 @@ class Trainer:
             with Profiler(os.path.join(self.result_dir, "profile")):
                 return self.train_parallel(episodes, num_replicas, chunk,
                                            verbose, device_traffic,
-                                           profile=False)
+                                           profile=False,
+                                           init_state=init_state,
+                                           init_buffers=init_buffers,
+                                           start_episode=start_episode)
         from ..parallel import ParallelDDPG
         from ..parallel.harness import run_chunked_episodes
         from ..sim.traffic_device import DeviceTraffic
@@ -203,8 +208,10 @@ class Trainer:
         topo0, traffic0 = self.driver.episode(0, False)
         _, one_obs = self.env.reset(jax.random.fold_in(base, 1000), topo0,
                                     traffic0)
-        state = pddpg.init(jax.random.fold_in(base, 0), one_obs)
-        buffers = pddpg.init_buffers(one_obs)
+        state = init_state if init_state is not None else \
+            pddpg.init(jax.random.fold_in(base, 0), one_obs)
+        buffers = init_buffers if init_buffers is not None else \
+            pddpg.init_buffers(one_obs)
 
         # one on-device sampler per scheduled topology (the scheduler
         # cycles training_network_files every `period` episodes)
@@ -231,15 +238,15 @@ class Trainer:
         # the scheduler may swap topologies mid-run, so drive the harness
         # one episode at a time with that episode's topology — passing the
         # GLOBAL step offset so the agent's warmup schedule sees one
-        # continuous run
-        for ep in range(episodes):
+        # continuous run (and a resumed run continues it exactly)
+        for ep in range(start_episode, episodes):
             topo = self.driver.topology_for(ep)
             traffic = episode_traffic(ep, topo)
             state, buffers, rets, succ, final = run_chunked_episodes(
                 pddpg, topo, lambda _: traffic, state, buffers,
                 1, steps_per_ep, chunk, self.seed + ep,
                 step_offset=ep * steps_per_ep)
-            sps = ((ep + 1) * steps_per_ep * num_replicas
+            sps = ((ep - start_episode + 1) * steps_per_ep * num_replicas
                    / (time.time() - start))
             row = {"episodic_return": rets[0], "mean_succ_ratio": succ[0],
                    "final_succ_ratio": final[0], "episode": ep, "sps": sps}

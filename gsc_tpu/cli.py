@@ -239,16 +239,20 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                           tensorboard=tensorboard)
         init_state = init_buffer = None
         start_episode = 0
-        if resume and replicas > 1:
-            raise click.BadParameter(
-                "--resume with --replicas > 1 is not supported yet "
-                "(replica-sharded replay has a different storage shape)")
         if resume:
             from .utils.checkpoint import load_full_or_partial
             topo0, traffic0 = driver.episode(0, False)
             _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
             example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
-            example_buffer = trainer.ddpg.init_buffer(obs0)
+            if replicas > 1:
+                # replica-sharded replay: [B, capacity, ...] leaves — a
+                # checkpoint from a matching --replicas run restores
+                # fully; anything else falls back to state-only
+                from .parallel import ParallelDDPG
+                example_buffer = ParallelDDPG(
+                    env, agent, num_replicas=replicas).init_buffers(obs0)
+            else:
+                example_buffer = trainer.ddpg.init_buffer(obs0)
             restored, buffer_ok = load_full_or_partial(
                 resume, example, example_buffer=example_buffer,
                 example_extra={"episode": _np.asarray(0, _np.int32)})
@@ -264,11 +268,19 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             init_state = restored["state"]
             start_episode = int(restored["extra"]["episode"]) \
                 if "extra" in restored else 0
+            if start_episode >= episodes:
+                # range(start, episodes) would be empty: no training, but
+                # the checkpoint would be REWRITTEN with the smaller
+                # counter — corrupting exact resume for later runs
+                raise click.BadParameter(
+                    f"--episodes ({episodes}) must exceed the checkpoint's "
+                    f"completed episode count ({start_episode})")
         result.runtime_start("train")
         if replicas > 1:
             state, buffer = trainer.train_parallel(
                 episodes, num_replicas=replicas, chunk=chunk,
-                verbose=verbose, profile=profile)
+                verbose=verbose, profile=profile, init_state=init_state,
+                init_buffers=init_buffer, start_episode=start_episode)
         else:
             state, buffer = trainer.train(episodes, verbose=verbose,
                                           profile=profile,

@@ -335,6 +335,25 @@ def test_cli_train_replicas(tmp_path):
                                         "--max-edges", "8"])
     assert r2.exit_code == 0, (r2.output, r2.exception)
 
+    # exact resume on the replica path: 2 episodes + checkpoint + 2 more
+    # must equal a straight 4-episode run (same traffic keys, same warmup
+    # schedule via step_offset, state PRNG carried in the checkpoint)
+    r3 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "4",
+                                        "--replicas", "2", "--chunk", "3",
+                                        "--result-dir",
+                                        str(tmp_path / "resp4")])
+    assert r3.exit_code == 0, (r3.output, r3.exception)
+    straight = json.loads(r3.output.strip().splitlines()[-1])
+    r4 = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "4",
+                                        "--replicas", "2", "--chunk", "3",
+                                        "--resume", out["checkpoint"],
+                                        "--result-dir",
+                                        str(tmp_path / "resp5")])
+    assert r4.exit_code == 0, (r4.output, r4.exception)
+    resumed = json.loads(r4.output.strip().splitlines()[-1])
+    assert resumed["mean_return"] == straight["mean_return"]
+    assert resumed["final_succ_ratio"] == straight["final_succ_ratio"]
+
 
 def test_logging_setup(tmp_path):
     """setup_logging attaches console + per-run file handlers
