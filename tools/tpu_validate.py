@@ -8,8 +8,23 @@ parent; results accumulate in TPU_VALIDATION.json):
 2. pallas    — compiled (non-interpret) Pallas GAT kernel vs the dense
                XLA embedder on the flagship shapes (the interpret-mode
                parity test runs in CI; this validates the real kernel)
-3. bench     — the flagship bench ladder (delegates to bench.py)
-4. learning  — a short full-scale learning-curve run (tools/learning_curve.py)
+3. bench     — the flagship bench ladder (delegates to bench.py; B=256
+               first, partial-result banking, compile cache)
+4. learning  — a short full-scale learning-curve run with ON-DEVICE
+               per-episode traffic (tools/learning_curve.py) — its wall
+               rate vs the bench device rate closes the r3 sustained-
+               throughput question
+5. gnn_bench — dense vs Pallas embedder timings at replay-batch shapes
+               (fwd and, via the round-4 custom VJP, fwd+bwd)
+6. profile   — substep trace at B=256, top fusions by self-time (the
+               20x-push evidence: batched-sort + threefry elision wins)
+7. rung5     — BASELINE config 5 with the FLAGSHIP architecture (factored
+               action head) at B=32: the r3 OOM must be gone
+
+After these land, run the quality sweep separately (it is hours, not
+minutes): ``python tools/quality_sweep.py --replicas 256 --episodes 24``
+— priors from the CPU sweep (BENCH_NOTES): spend cells on lr x sigma,
+skip longer learn bursts.
 """
 from __future__ import annotations
 
@@ -52,8 +67,13 @@ def run_stage(name, cmd, timeout, results):
         ok = r.returncode == 0
         out = (r.stdout or "")[-1500:]
         err = (r.stderr or "")[-1500:]
-    except subprocess.TimeoutExpired:
-        ok, out, err = False, "", f"timeout after {timeout}s"
+    except subprocess.TimeoutExpired as e:
+        # keep the partial stdout: bench/rung5 print banked measurement
+        # lines after every episode precisely so a timeout still yields
+        # numbers
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        ok, out, err = False, out[-1500:], f"timeout after {timeout}s"
     results[name] = {"ok": ok, "wall_s": round(time.time() - t0, 1),
                      "stdout_tail": out, "stderr_tail": err}
     print(f"[{name}] {'OK' if ok else 'FAIL'} "
@@ -61,6 +81,15 @@ def run_stage(name, cmd, timeout, results):
     with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
         json.dump(results, f, indent=1)
     return ok
+
+
+def _probe(py, timeout=240):
+    try:
+        r = subprocess.run([py, "-c", "import jax; print(jax.devices())"],
+                           timeout=timeout, capture_output=True, text=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def main():
@@ -72,12 +101,34 @@ def main():
         print("TPU backend unreachable — nothing to validate",
               file=sys.stderr)
         sys.exit(1)
-    run_stage("pallas", [py, "-c", _PALLAS_CHECK.format(repo=REPO)],
-              600, results)
-    run_stage("bench", [py, os.path.join(REPO, "bench.py")], 3600, results)
-    run_stage("learning",
-              [py, os.path.join(REPO, "tools", "learning_curve.py"),
-               "--replicas", "64", "--episodes", "12"], 3000, results)
+    # bench.py's own worst case (one grace rung + post-rung probe retries)
+    # can reach ~5600 s; the stage cap must sit above it
+    stages = [
+        ("pallas", [py, "-c", _PALLAS_CHECK.format(repo=REPO)], 600),
+        ("bench", [py, os.path.join(REPO, "bench.py")], 6000),
+        ("learning",
+         [py, os.path.join(REPO, "tools", "learning_curve.py"),
+          "--replicas", "256", "--episodes", "12"], 3000),
+        ("gnn_bench",
+         [py, os.path.join(REPO, "tools", "gnn_bench.py")], 900),
+        ("profile",
+         [py, os.path.join(REPO, "tools", "profile_substep.py"),
+          "--replicas", "256", "--chunk", "50"], 1500),
+        ("rung5", [py, os.path.join(REPO, "bench.py"), "--worker",
+                   "32", "10", "1", "rung5"], 2400),
+    ]
+    for i, (name, cmd, timeout) in enumerate(stages):
+        if i > 0 and not _probe(py):
+            # a faulted stage wedges the shared chip and every later
+            # process hangs at backend init — don't burn each remaining
+            # stage's full timeout discovering that
+            results[name] = {"ok": False, "skipped":
+                             "backend unhealthy after previous stage"}
+            print(f"[{name}] SKIP (backend unhealthy)", file=sys.stderr)
+            with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
+                json.dump(results, f, indent=1)
+            continue
+        run_stage(name, cmd, timeout, results)
     print(json.dumps(results["bench"], indent=1))
 
 
