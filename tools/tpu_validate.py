@@ -59,6 +59,22 @@ assert rel < 5e-2, (diff, rel)
 """
 
 
+PROBE_CODE = "import jax; print(jax.devices())"
+
+
+def _save(results):
+    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+def _text(raw):
+    """TimeoutExpired payloads are bytes (even with text=True) and can be
+    truncated mid-UTF-8-sequence by the kill."""
+    if isinstance(raw, bytes):
+        return raw.decode(errors="replace")
+    return raw or ""
+
+
 def run_stage(name, cmd, timeout, results):
     t0 = time.time()
     try:
@@ -68,25 +84,25 @@ def run_stage(name, cmd, timeout, results):
         out = (r.stdout or "")[-1500:]
         err = (r.stderr or "")[-1500:]
     except subprocess.TimeoutExpired as e:
-        # keep the partial stdout: bench/rung5 print banked measurement
-        # lines after every episode precisely so a timeout still yields
-        # numbers
-        out = e.stdout.decode() if isinstance(e.stdout, bytes) \
-            else (e.stdout or "")
-        ok, out, err = False, out[-1500:], f"timeout after {timeout}s"
+        # keep BOTH partial streams: bench/rung5 print banked measurement
+        # lines to stdout after every episode, and the compile/fault
+        # diagnostics land on stderr
+        ok = False
+        out = _text(e.stdout)[-1500:]
+        err = (f"timeout after {timeout}s | "
+               + _text(e.stderr))[-1500:]
     results[name] = {"ok": ok, "wall_s": round(time.time() - t0, 1),
                      "stdout_tail": out, "stderr_tail": err}
     print(f"[{name}] {'OK' if ok else 'FAIL'} "
           f"({results[name]['wall_s']}s)", file=sys.stderr)
-    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
-        json.dump(results, f, indent=1)
+    _save(results)
     return ok
 
 
 def _probe(py, timeout=240):
     try:
-        r = subprocess.run([py, "-c", "import jax; print(jax.devices())"],
-                           timeout=timeout, capture_output=True, text=True)
+        r = subprocess.run([py, "-c", PROBE_CODE], timeout=timeout,
+                           capture_output=True, text=True)
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
@@ -95,9 +111,7 @@ def _probe(py, timeout=240):
 def main():
     results = {}
     py = sys.executable
-    if not run_stage("probe", [py, "-c",
-                               "import jax; print(jax.devices())"],
-                     240, results):
+    if not run_stage("probe", [py, "-c", PROBE_CODE], 240, results):
         print("TPU backend unreachable — nothing to validate",
               file=sys.stderr)
         sys.exit(1)
@@ -117,19 +131,20 @@ def main():
         ("rung5", [py, os.path.join(REPO, "bench.py"), "--worker",
                    "32", "10", "1", "rung5"], 2400),
     ]
-    for i, (name, cmd, timeout) in enumerate(stages):
-        if i > 0 and not _probe(py):
-            # a faulted stage wedges the shared chip and every later
-            # process hangs at backend init — don't burn each remaining
-            # stage's full timeout discovering that
+    prev_ok = True
+    for name, cmd, timeout in stages:
+        # a faulted stage wedges the shared chip and every later process
+        # hangs at backend init — after a FAILED stage, re-probe instead
+        # of burning each remaining stage's full timeout discovering that
+        # (healthy-path runs pay no extra backend inits)
+        if not prev_ok and not _probe(py):
             results[name] = {"ok": False, "skipped":
                              "backend unhealthy after previous stage"}
             print(f"[{name}] SKIP (backend unhealthy)", file=sys.stderr)
-            with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
-                json.dump(results, f, indent=1)
+            _save(results)
             continue
-        run_stage(name, cmd, timeout, results)
-    print(json.dumps(results["bench"], indent=1))
+        prev_ok = run_stage(name, cmd, timeout, results)
+    print(json.dumps(results.get("bench", {}), indent=1))
 
 
 if __name__ == "__main__":
