@@ -364,7 +364,7 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     from .config.schema import DROP_REASONS, EnvLimits
     from .sim.engine import SimEngine
     from .sim.traffic import generate_traffic
-    from .topology.compiler import load_topology
+    from .topology.compiler import check_dt_quantization, load_topology
 
     svc = load_service(service,
                        resource_functions_path=resource_functions_path)
@@ -374,6 +374,7 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     topo = load_topology(network, max_nodes=max_nodes, max_edges=max_edges,
                          force_link_cap=sim_cfg.force_link_cap,
                          force_node_cap=sim_cfg.force_node_cap, seed=seed)
+    check_dt_quantization(topo, sim_cfg.dt, name=network)
     steps = int(np.ceil(duration / sim_cfg.run_duration))
     if steps < 1:
         raise click.BadParameter("duration must cover at least one "

@@ -17,7 +17,8 @@ import numpy as np
 from ..config.schema import SchedulerConfig, ServiceConfig, SimConfig
 from ..sim.state import TrafficSchedule
 from ..sim.traffic import TraceEvents, generate_traffic, traffic_capacity
-from ..topology.compiler import Topology, load_topology
+from ..topology.compiler import (Topology, check_dt_quantization,
+                                 load_topology)
 
 
 def _node_index(name: str) -> int:
@@ -56,6 +57,8 @@ class EpisodeDriver:
                 max_edges=max_edges, force_link_cap=sim_cfg.force_link_cap,
                 force_node_cap=sim_cfg.force_node_cap, seed=base_seed)
         self.inference_topology = inference_topology
+        for i, t in enumerate(self.topologies + [self.inference_topology]):
+            check_dt_quantization(t, sim_cfg.dt, name=f"topology[{i}]")
         self.trace = (TraceEvents.from_csv(sim_cfg.trace_path, _node_index)
                       if sim_cfg.trace_path else None)
         # fixed traffic capacity across episodes -> no recompiles

@@ -120,6 +120,14 @@ class ParallelDDPG:
         opens a fresh permutation frame, which is only correct at episode
         boundaries."""
         from ..env.permutation import ShuffleOps
+        if (self.agent.shuffle_nodes and num_steps is not None
+                and num_steps % self.agent.episode_steps != 0):
+            raise ValueError(
+                "chunked rollouts (num_steps < episode_steps) are "
+                "incompatible with shuffle_nodes: each chunk call opens a "
+                "fresh permutation frame, which is only correct at episode "
+                "boundaries — disable shuffle_nodes or roll out whole "
+                "episodes")
         rng, sub = jax.random.split(state.rng)
         shuffle = ShuffleOps(self.agent, self.env.limits)
         # per-replica node permutations, fresh each step, via the same

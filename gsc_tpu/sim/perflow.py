@@ -37,16 +37,33 @@ from ..topology.compiler import Topology
 
 @dataclass
 class PendingFlows:
-    """Flows waiting for an external decision (the SPRState analogue,
-    flow_controller.py:73-92: flow + network view)."""
+    """Flows waiting for an external decision plus the network view — the
+    full SPRState analogue (flow_controller.py:10-18: flow + network + sfcs
+    + network_stats), so per-flow algorithms never have to dig into SimState
+    themselves.
+
+    Per-flow fields are [K] over the waiting flows; network-view fields are
+    full-size ([N]/[E]/[N,P]) snapshots at the current substep."""
 
     slots: np.ndarray      # [K] flow-table slot indices
     node: np.ndarray       # [K] current node
     sfc: np.ndarray        # [K]
     position: np.ndarray   # [K] chain position
+    sf: np.ndarray         # [K] SF id needed next (chain_sf[sfc, position])
     dr: np.ndarray         # [K]
     ttl: np.ndarray        # [K]
+    egress: np.ndarray     # [K] egress node (-1: none)
     t: float               # current sim time (ms)
+    # --- network view (SimulatorState.network / network_stats parity:
+    # parse_network remaining caps + available_sf placement,
+    # simulator.py:176-202; network_metrics counters, metrics.py) ---
+    node_cap: np.ndarray       # [N] current interval node capacities
+    node_remaining: np.ndarray  # [N] cap minus current processed load
+    edge_cap: np.ndarray       # [E]
+    edge_remaining: np.ndarray  # [E] cap minus in-flight dr
+    sf_available: np.ndarray   # [N,P] bool: SF placed or still draining
+    path_delay: np.ndarray     # [N,N] all-pairs shortest path delay (ms)
+    network_stats: dict        # in_network/processed/dropped totals
 
     def __len__(self):
         return len(self.slots)
@@ -67,19 +84,55 @@ class PerFlowController:
         self.writer = writer
         self.episode = episode
 
-    def _pending(self, state: SimState) -> PendingFlows:
+    def _network_view(self, state: SimState):
+        """Current-interval capacity/placement snapshot (the controller's
+        parse_network step, flow_controller.py:34-41)."""
+        node_cap = np.asarray(
+            self.traffic.node_cap[min(int(state.run_idx),
+                                      self.traffic.node_cap.shape[0] - 1)])
+        node_rem = node_cap - np.asarray(state.node_load).sum(axis=-1)
+        edge_cap = np.asarray(self.topo.edge_cap)
+        edge_rem = edge_cap - np.asarray(state.edge_used)
+        return node_cap, node_rem, edge_cap, edge_rem
+
+    def _waiting_slots(self, state: SimState) -> np.ndarray:
+        """Slot indices of flows parked in DECIDE (cheap: flow arrays only —
+        polled every substep, so no network-view work here)."""
         f = state.flows
         waiting = np.asarray(f.phase == PH_DECIDE)
         chain_len = self.engine.tables.chain_len[np.asarray(f.sfc)]
         # egress routing stays automatic; only SF-position decisions wait
         waiting = waiting & (np.asarray(f.position) < chain_len)
-        slots = np.nonzero(waiting)[0]
+        return np.nonzero(waiting)[0]
+
+    def _pending(self, state: SimState) -> PendingFlows:
+        f = state.flows
+        tables = self.engine.tables
+        slots = self._waiting_slots(state)
+        sfc_all = np.asarray(f.sfc)
+        pos_all = np.asarray(f.position)
+        chain_len = tables.chain_len[sfc_all]
+        sfc = sfc_all[slots]
+        pos = pos_all[slots]
+        node_cap, node_rem, edge_cap, edge_rem = self._network_view(state)
+        m = state.metrics
         return PendingFlows(
-            slots=slots, node=np.asarray(f.node)[slots],
-            sfc=np.asarray(f.sfc)[slots],
-            position=np.asarray(f.position)[slots],
+            slots=slots, node=np.asarray(f.node)[slots], sfc=sfc,
+            position=pos,
+            sf=tables.chain_sf[sfc, np.minimum(pos, chain_len[slots] - 1)],
             dr=np.asarray(f.dr)[slots], ttl=np.asarray(f.ttl)[slots],
-            t=float(state.t))
+            egress=np.asarray(f.egress)[slots],
+            t=float(state.t),
+            node_cap=node_cap, node_remaining=node_rem,
+            edge_cap=edge_cap, edge_remaining=edge_rem,
+            sf_available=np.asarray(state.sf_available),
+            path_delay=np.asarray(self.topo.path_delay),
+            network_stats={
+                "total_flows": int(m.generated),
+                "successful_flows": int(m.processed),
+                "dropped_flows": int(m.dropped),
+                "in_network_flows": int(m.active),
+            })
 
     def run_until_decision(self, state: SimState, max_substeps: int = 10_000
                            ) -> tuple[SimState, PendingFlows]:
@@ -87,9 +140,8 @@ class PerFlowController:
         the substep budget is exhausted (the env.run-until-flow_trigger loop,
         flow_controller.py:30-42)."""
         for _ in range(max_substeps):
-            pending = self._pending(state)
-            if len(pending):
-                return state, pending
+            if len(self._waiting_slots(state)):
+                return state, self._pending(state)
             state = self.engine.apply_substep(state, self.topo, self.traffic,
                                               self._none)
         return state, self._pending(state)
@@ -102,18 +154,16 @@ class PerFlowController:
         dec = np.full(self.engine.M, -1, np.int32)
         dec[pending.slots] = destinations
         if self.writer is not None:
-            self._log_decisions(state, pending, destinations)
+            self._log_decisions(pending, destinations)
         return self.engine.apply_substep(state, self.topo, self.traffic,
                                          jnp.asarray(dec))
 
-    def _log_decisions(self, state: SimState, pending: PendingFlows,
+    def _log_decisions(self, pending: PendingFlows,
                        destinations: np.ndarray) -> None:
-        node_cap = np.asarray(
-            self.traffic.node_cap[min(int(state.run_idx),
-                                      self.traffic.node_cap.shape[0] - 1)])
-        node_rem = node_cap - np.asarray(state.node_load).sum(axis=-1)
-        edge_cap = np.asarray(self.topo.edge_cap)
-        edge_rem = edge_cap - np.asarray(state.edge_used)
+        # the pending record snapshots the deciding state's network view
+        node_rem = pending.node_remaining
+        edge_cap = pending.edge_cap
+        edge_rem = pending.edge_remaining
         adj = np.asarray(self.topo.adj_edge_id)
         for i, slot in enumerate(pending.slots):
             dest = int(destinations[i])
@@ -129,6 +179,6 @@ class PerFlowController:
                 lcap = edge_cap[eid] if eid >= 0 else -1
                 lrem = edge_rem[eid] if eid >= 0 else -1
             self.writer.write_flow_action(
-                self.episode, float(state.t), int(slot),
+                self.episode, pending.t, int(slot),
                 float(pending.ttl[i]), float(pending.ttl[i]), cur, dst_repr,
                 node_rem[cur], next_rem, lcap, lrem)

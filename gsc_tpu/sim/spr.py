@@ -1,0 +1,111 @@
+"""Shortest-path-routing (SPR) per-flow heuristic — the example algorithm
+the reference's per-flow control granularity exists for.
+
+The reference's ``FlowController`` hands each waiting flow to an external
+algorithm as an ``SPRState`` (flow + network view + stats,
+coordsim/controller/flow_controller.py:10-18) and applies the returned
+destination node (flow_controller.py:44-92).  No concrete algorithm ships
+inside the reference tree — this module provides the canonical one the API
+is named after: process at the nearest capable node, routing over shortest
+paths.
+
+Decision rule per waiting flow (given ``PendingFlows``):
+
+1. If the current node can host the flow's next SF — the SF is already
+   available there (or could be placed, when ``place_on_decision``) and the
+   node has ``dr`` worth of remaining capacity — process HERE
+   (destination = current node; the engine's place-on-decision installs the
+   SF if absent, engine.py ext_decisions path).
+2. Otherwise pick the node with remaining capacity that minimizes shortest-
+   path delay from the current node, preferring nodes where the SF is
+   already running (no startup delay, no placement churn); unreachable
+   nodes (infinite path delay) and nodes whose path exceeds the flow's TTL
+   are excluded.
+3. If no node qualifies, stay put — the engine then attempts processing at
+   the current node and records the authentic NODE_CAP drop
+   (base_processor.py:51-101 semantics), matching what the reference's
+   simulator does to an algorithm with nowhere to send a flow.
+
+Host-side numpy on the ``PendingFlows`` network view: this is the external
+(non-JAX) algorithm path; the on-device analogue is
+``SimEngine.apply_per_flow`` with a jitted policy.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .perflow import PendingFlows, PerFlowController
+from .state import SimState
+
+
+class ShortestPathAlgo:
+    """Greedy nearest-capable-node per-flow algorithm (see module doc).
+
+    ``prefer_running=True`` breaks delay ties toward nodes where the needed
+    SF is already available, and only falls back to empty nodes when no
+    running instance is reachable."""
+
+    def __init__(self, prefer_running: bool = True):
+        self.prefer_running = prefer_running
+
+    def decide(self, pending: PendingFlows) -> np.ndarray:
+        """[K] destination node per pending flow (>=0 always: rule 3 keeps
+        undecidable flows at their current node rather than parking them
+        forever with -1)."""
+        from ..topology.compiler import INF_DELAY
+
+        k = len(pending)
+        out = np.empty(k, np.int32)
+        # working copy: decisions in one batch land in the SAME substep, so
+        # each routed flow must reserve its dr or two flows could jointly
+        # overload a node the sequential reference algorithm would not
+        node_rem = pending.node_remaining.copy()
+        avail = pending.sf_available
+        pd = pending.path_delay
+        for i in range(k):
+            cur = int(pending.node[i])
+            sf = int(pending.sf[i])
+            dr = float(pending.dr[i])
+            fits = node_rem >= dr
+            if fits[cur]:
+                out[i] = cur
+                node_rem[cur] -= dr
+                continue
+            # pad/unreachable pairs carry the finite INF_DELAY sentinel,
+            # not inf (compiler.py) — compare against it, not isfinite
+            reach = (pd[cur] < INF_DELAY) & (pd[cur] <= pending.ttl[i])
+            cand = fits & reach
+            if self.prefer_running and (cand & avail[:, sf]).any():
+                cand = cand & avail[:, sf]
+            if cand.any():
+                delays = np.where(cand, pd[cur], np.inf)
+                out[i] = int(np.argmin(delays))
+                node_rem[out[i]] -= dr
+            else:
+                out[i] = cur  # rule 3: authentic NODE_CAP drop
+        return out
+
+
+def run_spr_episode(controller: PerFlowController, state: SimState,
+                    num_substeps: int, algo: ShortestPathAlgo = None
+                    ) -> SimState:
+    """Drive ``PerFlowController`` with ``ShortestPathAlgo`` for
+    ``num_substeps`` engine substeps — the end-to-end per-flow control loop
+    a reference user writes against FlowController.get_init_state /
+    get_next_state (flow_controller.py:30-92)."""
+    algo = algo or ShortestPathAlgo()
+    dt = controller.engine.dt
+
+    def substeps(st):
+        return int(round(float(st.t) / dt))
+
+    while substeps(state) < num_substeps:
+        state, pending = controller.run_until_decision(
+            state, max_substeps=num_substeps - substeps(state))
+        if not len(pending):
+            break  # budget ran out with nothing waiting
+        if substeps(state) < num_substeps:
+            state = controller.decide(state, pending, algo.decide(pending))
+        else:
+            break
+    return state

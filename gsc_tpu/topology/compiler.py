@@ -248,6 +248,47 @@ def compile_topology(spec: NetworkSpec, max_nodes: int = 24,
     )
 
 
+def check_dt_quantization(topo: Topology, dt: float,
+                          name: str = "") -> bool:
+    """Warn when edge delays are not integer multiples of ``dt``.
+
+    The fixed-step engine quantizes hop timers to the substep grid, so a
+    link delay of e.g. 5.77 ms at dt=1 releases capacity up to dt early
+    relative to the reference's event-driven timeline — measurably different
+    contention physics on geo-delay topologies (BT-Europe cap-1: 398 vs 349
+    processed at dt=1; exact at dt=0.25 — tests/test_reference_parity.py).
+    Returns True when a warning fired so callers/tests can assert on it.
+    """
+    import warnings
+
+    delays = np.asarray(topo.edge_delay, np.float64)[np.asarray(topo.edge_mask)]
+
+    def _fractional(f):
+        # relative tolerance: float32-sourced delays carry ~1e-7 relative
+        # representation error, which an absolute cutoff misreads as
+        # fractional once f is large (e.g. 4.7/0.1 = 46.999998)
+        return np.abs(f - np.round(f)) > 1e-6 * np.maximum(np.abs(f), 1.0)
+
+    bad = _fractional(delays / dt)
+    if bad.any():
+        suggest = dt
+        for cand in (0.5, 0.25, 0.125, 0.1, 0.05, 0.025):
+            if not _fractional(delays / cand).any():
+                suggest = cand
+                break
+        label = f" {name!r}" if name else ""
+        warnings.warn(
+            f"topology{label} has {int(bad.sum())} edge delay(s) that are "
+            f"not integer multiples of dt={dt} (e.g. {delays[bad][0]:.3f} ms)"
+            f"; the fixed-step engine quantizes hop timers to dt, which "
+            f"diverges from the reference's event-driven contention physics"
+            + (f" — consider dt={suggest}" if suggest != dt else "")
+            + " (see tests/test_reference_parity.py BT-Europe note)",
+            stacklevel=2)
+        return True
+    return False
+
+
 def load_topology(path: str, max_nodes: int = 24, max_edges: int = 37,
                   force_link_cap: Optional[float] = None,
                   force_node_cap: Optional[Tuple[float, float]] = None,

@@ -233,3 +233,21 @@ def test_harness_global_step_offsets():
                          episodes=1, episode_steps=4, chunk=2, seed=0,
                          step_offset=8)
     assert spy.starts == [8, 10]
+
+
+def test_chunked_rollout_rejects_shuffle():
+    """Chunked rollouts open a fresh permutation frame per device call —
+    only correct at episode boundaries — so combining num_steps <
+    episode_steps with shuffle_nodes must raise instead of silently
+    corrupting the obs<->action frame alignment."""
+    import dataclasses
+
+    pddpg, state, buffers, env_states, obs, topo, traffic = \
+        _deterministic_setup(episode_steps=4)
+    pddpg.agent = dataclasses.replace(pddpg.agent, shuffle_nodes=True)
+    with pytest.raises(ValueError, match="shuffle_nodes"):
+        pddpg.rollout_episodes(state, buffers, env_states, obs, topo,
+                               traffic, jnp.int32(0), 2)
+    # whole-episode calls with shuffling stay allowed
+    pddpg.rollout_episodes(state, buffers, env_states, obs, topo, traffic,
+                           jnp.int32(0), 4)

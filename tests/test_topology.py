@@ -159,3 +159,30 @@ def test_large_zoo_network_shapes():
         delays = [d for (_, _, _, d) in spec.edges]
         assert min(delays) >= 0 and max(delays) < 150.0
         assert len({round(d, 3) for d in delays}) >= 4
+
+
+def test_dt_quantization_warning():
+    """Fractional edge delays at dt=1 warn with a dt suggestion; integer
+    delays stay silent (the BT-Europe divergence guard — the fixed-step
+    engine quantizes hop timers, tests/test_reference_parity.py)."""
+    import pytest
+
+    from gsc_tpu.topology.compiler import NetworkSpec, check_dt_quantization
+
+    frac = compile_topology(NetworkSpec(
+        node_caps=[1.0, 1.0], node_types=["Ingress", "Normal"],
+        edges=[(0, 1, 10.0, 5.75)]), max_nodes=4, max_edges=4)
+    with pytest.warns(UserWarning, match="not integer multiples of dt=1"):
+        assert check_dt_quantization(frac, 1.0, name="bt-like")
+    # the suggestion names a dt that actually divides the delays
+    with pytest.warns(UserWarning, match="dt=0.25"):
+        check_dt_quantization(frac, 1.0)
+
+    whole = compile_topology(NetworkSpec(
+        node_caps=[1.0, 1.0], node_types=["Ingress", "Normal"],
+        edges=[(0, 1, 10.0, 3.0)]), max_nodes=4, max_edges=4)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert not check_dt_quantization(whole, 1.0)
