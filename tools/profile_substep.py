@@ -12,6 +12,17 @@ total self duration plus the per-substep wall.
     python tools/profile_substep.py --cpu --replicas 4 --chunk 5  # smoke
 
 Only FRESH trace dirs are globbed (stale files double-count — r3 gotcha).
+
+``--mfu`` switches to the roofline sweep: for each replica count it lowers
+the chunked rollout call, reads XLA's own per-executable cost analysis
+(flops + bytes accessed — exact for the one-hot engine, whose FLOPs are
+static dot shapes), times the call, and prints sustained FLOP/s vs chip
+peak plus the arithmetic-intensity regime.  This is the VERDICT r4 item:
+"what fraction of peak does the chip sustain, and is the substep
+FLOP-bound or op-count-bound at B=256?"
+
+    python tools/profile_substep.py --mfu --replicas 64 256 512
+    python tools/profile_substep.py --mfu --cpu --replicas 2 4 --chunk 5
 """
 from __future__ import annotations
 
@@ -28,29 +39,25 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--replicas", type=int, default=256)
-    ap.add_argument("--chunk", type=int, default=50)
-    ap.add_argument("--calls", type=int, default=3)
-    ap.add_argument("--top", type=int, default=25)
-    ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--episode-steps", type=int, default=200)
-    args = ap.parse_args()
+# TPU v5e (v5 lite) single-chip peaks; overridable for other parts.
+PEAK_BF16_FLOPS = float(os.environ.get("GSC_PEAK_BF16_FLOPS", 197e12))
+PEAK_HBM_BPS = float(os.environ.get("GSC_PEAK_HBM_BPS", 819e9))
 
+
+def _build(env_steps, B, chunk):
+    """Shared setup: flagship scenario, device traffic, chunked rollout."""
     import jax
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from __graft_entry__ import _flagship
     from gsc_tpu.parallel import ParallelDDPG
     from gsc_tpu.sim.traffic_device import DeviceTraffic
 
-    T, B, chunk = args.episode_steps, args.replicas, args.chunk
-    env, agent, topo, _ = _flagship(episode_steps=T, gen_traffic=False)
-    dt = DeviceTraffic(env.sim_cfg, env.service, topo, T)
-    traffic = jax.jit(lambda k: dt.sample_batch(k, B))(jax.random.PRNGKey(0))
+    env, agent, topo, _ = _flagship(episode_steps=env_steps,
+                                    gen_traffic=False)
+    dt = DeviceTraffic(env.sim_cfg, env.service, topo, env_steps)
+    traffic = jax.jit(lambda k: dt.sample_batch(k, B))(
+        jax.random.PRNGKey(0))
     pddpg = ParallelDDPG(env, agent, num_replicas=B)
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
@@ -60,6 +67,109 @@ def main():
     def call(state, buffers, env_states, obs, start):
         return pddpg.rollout_episodes(state, buffers, env_states, obs,
                                       topo, traffic, jnp.int32(start), chunk)
+
+    return call, (state, buffers, env_states, obs)
+
+
+def _cost(compiled):
+    """Flops/bytes from XLA's executable cost analysis (version-tolerant:
+    older jaxlibs return a per-device list)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    byts = float(ca.get("bytes accessed", 0.0))
+    return flops, byts
+
+
+def mfu_sweep(args):
+    """Roofline table: XLA-counted FLOPs/bytes per rollout call vs measured
+    wall, at each replica count.  Regime call: compare the measured wall to
+    the compute-roof time (flops/peak) and memory-roof time (bytes/bw) —
+    if the wall dwarfs both roofs, the substep is op-COUNT (launch/fusion
+    latency) bound, which is what the r3 trace showed pre-one-hot."""
+    import jax
+
+    chunk = args.chunk
+    rows = []
+    for B in args.replicas:
+        call, carry = _build(args.episode_steps, B, chunk)
+        lowered = jax.jit(call).lower(*carry, 0)
+        compiled = lowered.compile()
+        flops, byts = _cost(compiled)
+        n_fusions = compiled.as_text().count(" fusion(")
+        out = compiled(*carry, 0)           # warm (engine already compiled)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for c in range(args.calls):
+            out = compiled(*out[:4], (c + 1) * chunk)
+        jax.block_until_ready(out)
+        wall = (time.time() - t0) / args.calls
+        # per-substep figures: one rollout call = chunk control steps, each
+        # sim_cfg.run_duration/dt substeps; flops is per CALL
+        t_flops = flops / PEAK_BF16_FLOPS
+        t_bytes = byts / PEAK_HBM_BPS
+        roof = max(t_flops, t_bytes)
+        if wall > 3 * roof:
+            regime = "op-count-bound"
+        elif t_flops >= t_bytes:
+            regime = "FLOP-bound"
+        else:
+            regime = "bytes-bound"
+        rows.append({
+            "backend": jax.default_backend(),  # TPU peaks are meaningless
+                                               # on the --cpu smoke path
+            "replicas": B, "chunk": chunk,
+            "wall_per_call_s": round(wall, 4),
+            "env_steps_per_sec": round(chunk * B / wall, 1),
+            "gflops_per_call": round(flops / 1e9, 2),
+            "gbytes_per_call": round(byts / 1e9, 3),
+            "sustained_tflops": round(flops / wall / 1e12, 3),
+            "mfu_vs_bf16_peak": round(flops / wall / PEAK_BF16_FLOPS, 4),
+            "hbm_frac": round(byts / wall / PEAK_HBM_BPS, 4),
+            "arith_intensity": round(flops / max(byts, 1.0), 2),
+            "compute_roof_s": round(t_flops, 5),
+            "memory_roof_s": round(t_bytes, 5),
+            "hlo_fusions": n_fusions,
+            "regime": regime,
+        })
+        print(json.dumps(rows[-1]))
+    print(json.dumps({"backend": jax.default_backend(),
+                      "peak_bf16_tflops": PEAK_BF16_FLOPS / 1e12,
+                      "peak_hbm_gbps": PEAK_HBM_BPS / 1e9,
+                      "note": ("engine dots run f32 Precision.HIGHEST "
+                               "(multi-pass bf16 on the MXU), so MXU "
+                               "issue-slot occupancy is ~3-6x the raw "
+                               "mfu_vs_bf16_peak figure"),
+                      "rows": rows}, indent=1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, nargs="+", default=[256])
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--calls", type=int, default=3)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--episode-steps", type=int, default=200)
+    ap.add_argument("--mfu", action="store_true",
+                    help="roofline sweep over --replicas instead of a trace")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.mfu:
+        mfu_sweep(args)
+        return
+
+    if len(args.replicas) > 1:
+        raise SystemExit("trace mode profiles ONE replica count; pass a "
+                         "single --replicas value (or use --mfu to sweep)")
+    B, chunk = args.replicas[0], args.chunk
+    call, (state, buffers, env_states, obs) = _build(
+        args.episode_steps, B, chunk)
 
     # compile + warm
     out = call(state, buffers, env_states, obs, 0)

@@ -1,0 +1,222 @@
+"""Non-learned quality anchors — the BASELINE-protocol comparison the
+parity oracles can't give.
+
+The reference's torch/torch-geometric agent stack is not installable in
+this image, so its *trained* policy can't be re-run here.  This tool
+builds the substitute anchor the VERDICT asks for: score NON-LEARNED
+baselines with the exact same env/reward/success accounting the learned
+agent is scored with, on the same scenarios, so the learned numbers have
+external yardsticks instead of only their own first-vs-last deltas:
+
+- ``uniform``  — equal scheduling weight to every real node (the
+  reference's dummy uniform schedule, coordsim/main.py dummy data /
+  ``cli simulate``'s default).
+- ``greedy``   — min-load: each control interval, ALL weight on the node
+  with the most remaining capacity (cap_now - current load), recomputed
+  every interval.
+- ``prop``     — capacity-proportional: weight each destination by its
+  remaining capacity (a classic load-balancer; the strongest non-learned
+  anchor here).
+- ``learned``  — optional (``--checkpoint``): greedy actor from a
+  ``cli train`` / checkpoint file, rolled out with the identical loop.
+
+Scenarios:
+- ``flagship`` — Abilene in4-rand-cap1-2, abc chain, 200-step episodes
+  (the benchmark workload of BASELINE.md).
+- ``unseen``   — the r3 generalization setting: a mutate_caps Abilene
+  variant whose cap seed is OUTSIDE the 4-variant training schedule
+  (seeds 0-3 train, seed 4 here).
+
+Episodes run CHUNKED (50-step device calls) per the TPU envelope; every
+policy is vmapped over ``--replicas`` envs with per-replica traffic.
+
+    python tools/quality_anchor.py --cpu --replicas 4 --episodes 2
+    python tools/quality_anchor.py --replicas 64 --episodes 4 \
+        --checkpoint results/.../checkpoint
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def make_policy(kind, env, actor=None, actor_params=None):
+    """-> policy(env_state, obs, topo, cap_now) -> flat [A] action in [0,1].
+    All policies are pure jnp functions of the replica's own state, so they
+    vmap and run inside the chunked rollout scan."""
+    import jax
+    import jax.numpy as jnp
+
+    n, c, s, _ = env.limits.scheduling_shape
+
+    def _sched_from_dest(w):
+        # [N] destination weights -> [N,C,S,N] (same weights for every
+        # (src, sfc, sf) row; env.step masks padded src/dst and the WRR
+        # normalizes each row)
+        return jnp.broadcast_to(w, (n, c, s, n)).reshape(-1)
+
+    if kind == "uniform":
+        def policy(env_state, obs, topo, cap_now):
+            return _sched_from_dest(topo.node_mask.astype(jnp.float32))
+    elif kind == "greedy":
+        def policy(env_state, obs, topo, cap_now):
+            rem = cap_now - env_state.sim.node_load.sum(-1)
+            rem = jnp.where(topo.node_mask, rem, -jnp.inf)
+            return _sched_from_dest(
+                jax.nn.one_hot(jnp.argmax(rem), n, dtype=jnp.float32))
+    elif kind == "prop":
+        def policy(env_state, obs, topo, cap_now):
+            rem = cap_now - env_state.sim.node_load.sum(-1)
+            w = jnp.clip(rem, 0.0) + 1e-3
+            return _sched_from_dest(w * topo.node_mask)
+    elif kind == "learned":
+        def policy(env_state, obs, topo, cap_now):
+            a = jnp.clip(actor.apply(actor_params, obs), 0.0, 1.0)
+            return env.process_action(a)
+    else:
+        raise ValueError(kind)
+    return policy
+
+
+def score_policy(env, topo, traffic_fn, policy, steps, chunk, replicas,
+                 episodes, seed):
+    """Mean episodic return / success over ``episodes`` episodes of
+    ``replicas`` vmapped envs (fresh traffic per episode via
+    ``traffic_fn(ep)``); episodes run as ``steps/chunk`` chunked device
+    calls (never one long scan — the TPU per-call envelope).  One compile
+    per policy: traffic is an argument of the jitted chunk call."""
+    import jax
+    import jax.numpy as jnp
+
+    traffic = traffic_fn(0)
+
+    t_steps = traffic.node_cap.shape[1]
+
+    def one_step(carry, _, traf):
+        env_state, obs = carry
+        cap_now = traf.node_cap[
+            jnp.clip(env_state.sim.run_idx, 0, t_steps - 1)]
+        action = policy(env_state, obs, topo, cap_now)
+        env_state, obs, reward, done, info = env.step(
+            env_state, topo, traf, action)
+        return (env_state, obs), (reward, info["succ_ratio"])
+
+    # traffic is an ARGUMENT (not a closure) so successive episodes with
+    # fresh traffic hit the same compiled executable
+    @jax.jit
+    def chunk_call(env_states, obs, traffic):
+        def per_replica(env_state, ob, traf):
+            return jax.lax.scan(
+                functools.partial(one_step, traf=traf),
+                (env_state, ob), None, length=chunk)
+        (env_states, obs), (rews, succs) = jax.vmap(per_replica)(
+            env_states, obs, traffic)
+        return env_states, obs, rews.sum(1), succs[:, -1]
+
+    reset = jax.jit(jax.vmap(lambda k, t: env.reset(k, topo, t)))
+    rets, succs = [], []
+    for ep in range(episodes):
+        traffic = traffic_fn(ep)
+        keys = jax.random.split(
+            jax.random.PRNGKey(seed + ep), replicas)
+        env_states, obs = reset(keys, traffic)
+        total = jnp.zeros((replicas,))
+        last_succ = None
+        for _ in range(steps // chunk):
+            env_states, obs, rews, last_succ = chunk_call(
+                env_states, obs, traffic)
+            total = total + rews
+        rets.append(float(total.mean()))
+        succs.append(float(last_succ.mean()))
+    return (sum(rets) / len(rets), sum(succs) / len(succs))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=16)
+    ap.add_argument("--episodes", type=int, default=2,
+                    help="episodes per scenario (fresh traffic each)")
+    ap.add_argument("--episode-steps", type=int, default=200)
+    ap.add_argument("--chunk", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--checkpoint", default=None,
+                    help="score a trained actor too (cli train checkpoint)")
+    ap.add_argument("--scenarios", nargs="+",
+                    default=["flagship", "unseen"],
+                    choices=["flagship", "unseen"])
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from __graft_entry__ import _flagship
+    from gsc_tpu.sim.traffic_device import DeviceTraffic
+    from gsc_tpu.topology.compiler import compile_topology
+    from gsc_tpu.topology.synthetic import abilene, mutate_caps
+
+    steps, chunk, B = args.episode_steps, args.chunk, args.replicas
+    if steps % chunk:
+        raise SystemExit(f"--chunk {chunk} must divide "
+                         f"--episode-steps {steps}")
+    env, agent_cfg, topo_flag, _ = _flagship(episode_steps=steps,
+                                             gen_traffic=False)
+
+    scen_topos = {}
+    if "flagship" in args.scenarios:
+        scen_topos["flagship"] = topo_flag
+    if "unseen" in args.scenarios:
+        # cap seed 4 = first variant OUTSIDE the r3 4-network training
+        # schedule (seeds 0-3); same (1, 3) cap range as rand-cap1-2
+        scen_topos["unseen"] = compile_topology(
+            mutate_caps(abilene(), (1, 3), seed=4),
+            max_nodes=env.limits.max_nodes,
+            max_edges=env.limits.max_edges)
+
+    policies = {k: make_policy(k, env) for k in ("uniform", "greedy",
+                                                 "prop")}
+    if args.checkpoint:
+        from gsc_tpu.agents.ddpg import DDPG
+        from gsc_tpu.utils.checkpoint import load_full_or_partial
+        ddpg = DDPG(env, agent_cfg)
+        batched = DeviceTraffic(env.sim_cfg, env.service, topo_flag,
+                                steps).sample_batch(jax.random.PRNGKey(0), 1)
+        one_traffic = jax.tree_util.tree_map(lambda x: x[0], batched)
+        _, obs0 = env.reset(jax.random.PRNGKey(0), topo_flag, one_traffic)
+        example = ddpg.init(jax.random.PRNGKey(0), obs0)
+        restored, _ = load_full_or_partial(args.checkpoint, example)
+        policies["learned"] = make_policy(
+            "learned", env, actor=ddpg.actor,
+            actor_params=restored["state"].actor_params)
+
+    table = {}
+    for scen, topo in scen_topos.items():
+        dt = DeviceTraffic(env.sim_cfg, env.service, topo, steps)
+        sample = jax.jit(dt.sample_batch, static_argnums=1)
+
+        def traffic_fn(ep):
+            return sample(
+                jax.random.fold_in(jax.random.PRNGKey(args.seed), ep), B)
+
+        for name, pol in policies.items():
+            t0 = time.time()
+            r, s = score_policy(env, topo, traffic_fn, pol, steps, chunk,
+                                B, args.episodes, args.seed)
+            row = {"mean_return": round(r, 3),
+                   "final_succ_ratio": round(s, 4),
+                   "episodes": args.episodes, "replicas": B,
+                   "wall_s": round(time.time() - t0, 1)}
+            table[f"{scen}/{name}"] = row
+            print(json.dumps({"scenario": scen, "policy": name, **row}))
+    print(json.dumps({"backend": jax.default_backend(),
+                      "episode_steps": steps, "table": table}, indent=1))
+
+
+if __name__ == "__main__":
+    main()
