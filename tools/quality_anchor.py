@@ -56,8 +56,12 @@ def make_policy(kind, env, actor=None, actor_params=None):
 
     def _sched_from_dest(w):
         # [N] destination weights -> [N,C,S,N] (same weights for every
-        # (src, sfc, sf) row; env.step masks padded src/dst and the WRR
-        # normalizes each row)
+        # (src, sfc, sf) row; env.step masks padded src/dst).  Rows MUST
+        # be normalized here: the engine's WRR picks argmax(w - realized
+        # ratio) (engine.py:508-517) and realized ratios sum to 1, so
+        # unnormalized rows degenerate to winner-take-all — only the
+        # learned-agent path's post_process_action normalizes.
+        w = w / jnp.maximum(w.sum(), 1e-9)
         return jnp.broadcast_to(w, (n, c, s, n)).reshape(-1)
 
     if kind == "uniform":
@@ -93,14 +97,12 @@ def score_policy(env, topo, traffic_fn, policy, steps, chunk, replicas,
     import jax
     import jax.numpy as jnp
 
-    traffic = traffic_fn(0)
-
-    t_steps = traffic.node_cap.shape[1]
-
     def one_step(carry, _, traf):
         env_state, obs = carry
+        # traf is the per-replica schedule here (inside vmap): [T, N]
         cap_now = traf.node_cap[
-            jnp.clip(env_state.sim.run_idx, 0, t_steps - 1)]
+            jnp.clip(env_state.sim.run_idx, 0,
+                     traf.node_cap.shape[0] - 1)]
         action = policy(env_state, obs, topo, cap_now)
         env_state, obs, reward, done, info = env.step(
             env_state, topo, traf, action)
@@ -199,10 +201,14 @@ def main():
     for scen, topo in scen_topos.items():
         dt = DeviceTraffic(env.sim_cfg, env.service, topo, steps)
         sample = jax.jit(dt.sample_batch, static_argnums=1)
+        traffic_cache = {}  # every policy scores the SAME traffic draws
 
         def traffic_fn(ep):
-            return sample(
-                jax.random.fold_in(jax.random.PRNGKey(args.seed), ep), B)
+            if ep not in traffic_cache:
+                traffic_cache[ep] = sample(
+                    jax.random.fold_in(jax.random.PRNGKey(args.seed), ep),
+                    B)
+            return traffic_cache[ep]
 
         for name, pol in policies.items():
             t0 = time.time()
