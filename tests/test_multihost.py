@@ -6,6 +6,10 @@ import re
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # ~86 s: real 2-process gRPC dryrun
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

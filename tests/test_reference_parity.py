@@ -37,6 +37,10 @@ import pytest
 
 REFERENCE = os.environ.get("GSC_REFERENCE_DIR", "/root/reference")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+from reward_curve import no_tpu_env  # noqa: E402  (single env-sanitizer)
 
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(REFERENCE),
@@ -121,6 +125,12 @@ ORACLE = {
 STEPS = 50
 SEED = 1234
 
+# dt=0.25 oracles (fractional geo delays) cost 4x the substeps —
+# the ~2-minute tail of the suite; quick tier skips them
+_PARAMS = [pytest.param(k, marks=pytest.mark.slow)
+           if ORACLE[k].get("overrides") else k
+           for k in sorted(ORACLE)]
+
 
 def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37,
                 config=CONFIG):
@@ -130,7 +140,6 @@ def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37,
     is shared with the reward-curve anchor so the two can't diverge."""
     from gsc_tpu.config.schema import DROP_REASONS
 
-    sys.path.insert(0, os.path.join(REPO, "tools"))
     from reward_curve import uniform_engine_run
 
     metrics, _, _ = uniform_engine_run(
@@ -147,7 +156,7 @@ def _run_engine(network_rel, overrides=None, max_nodes=24, max_edges=37,
     }
 
 
-@pytest.mark.parametrize("name", sorted(ORACLE.keys()))
+@pytest.mark.parametrize("name", _PARAMS)
 def test_engine_matches_reference(name):
     want = ORACLE[name]
     mn, me = want.get("limits", (24, 37))
@@ -177,12 +186,11 @@ def test_engine_matches_reference(name):
         assert got["drop_reasons"] == want["drop_reasons"]
 
 
-@pytest.mark.parametrize("name", sorted(ORACLE.keys()))
+@pytest.mark.parametrize("name", _PARAMS)
 def test_oracle_numbers_are_current(name):
     """Re-run the reference itself and verify the frozen constants."""
     want = ORACLE[name]
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}  # skip TPU registration: no jax
+    env = no_tpu_env()  # skip TPU registration: no jax
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "run_reference.py"),
          "--mode", "interface", "--network", want["network"],
@@ -252,8 +260,7 @@ def test_perflow_engine_matches_reference():
 def test_perflow_oracle_numbers_are_current():
     """Re-run the reference FlowController itself and verify the frozen
     constants."""
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}
+    env = no_tpu_env()
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "run_reference.py"),
          "--mode", "perflow", "--network", PERFLOW["network"],
@@ -278,8 +285,7 @@ def test_reward_curve_matches_reference():
     constant reward offset through the /15 diameter term); shape must
     match to r > 0.99.  tools/reward_curve.py is the measurement; 25
     steps keeps CI cost at half the 50-step exhibit."""
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}
+    env = no_tpu_env()
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "reward_curve.py"),
          "--steps", "25"],

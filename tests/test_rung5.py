@@ -14,6 +14,8 @@ from gsc_tpu.env.env import ServiceCoordEnv
 from gsc_tpu.sim import SimEngine, generate_traffic
 from gsc_tpu.topology.compiler import compile_topology
 from gsc_tpu.topology.synthetic import random_network
+
+pytestmark = pytest.mark.slow  # ~87 s: 200-node sharded step compile
 from gsc_tpu.utils.debug import assert_invariants
 
 

@@ -1,0 +1,127 @@
+"""20x-push lever sweep — rollout DEVICE rate across the engine knobs
+that the r4 profile work identified but never measured on chip:
+
+- ``scan_unroll``: the substep loop is a chain of small fusions, so scan
+  loop machinery is a visible wall fraction (engine.py:283-286);
+- ``max_flows``: every [M,*] one-hot contraction scales with the flow
+  table; the flagship's M=128 has headroom over its ~64-flow peak
+  occupancy (arrival budget right-sizing, VERDICT r4 item 2);
+- replicas x chunk: the throughput-vs-per-call-wall trade under the
+  tunnel's per-call deadline.
+
+Each cell times ``--calls`` chunked rollout calls (compile + 1 warm call
+excluded) and prints a JSON row; the last line is the winner.  Run it in
+a dedicated chip window (single process — never concurrent with bench):
+
+    python tools/lever_sweep.py                       # default grid
+    python tools/lever_sweep.py --cpu --grid smoke    # CPU smoke
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+GRIDS = {
+    # (replicas, chunk, max_flows, scan_unroll)
+    "default": list(itertools.product((256, 512), (50,), (96, 128),
+                                      (1, 2, 4))),
+    "wide": list(itertools.product((256, 512), (25, 50, 100), (96, 128),
+                                   (1, 2, 4))),
+    "smoke": [(2, 5, 32, 1), (2, 5, 32, 2)],
+}
+
+
+def measure(B, chunk, max_flows, unroll, calls, episode_steps):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship
+    from gsc_tpu.env.env import ServiceCoordEnv
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim.traffic_device import DeviceTraffic
+
+    env0, agent, topo, _ = _flagship(episode_steps=episode_steps,
+                                     max_flows=max_flows,
+                                     gen_traffic=False)
+    if unroll != 1:
+        env0 = ServiceCoordEnv(
+            env0.service, dataclasses.replace(env0.sim_cfg,
+                                              scan_unroll=unroll),
+            agent, env0.limits)
+    dt = DeviceTraffic(env0.sim_cfg, env0.service, topo, episode_steps)
+    traffic = jax.jit(lambda k: dt.sample_batch(k, B))(jax.random.PRNGKey(0))
+    pddpg = ParallelDDPG(env0, agent, num_replicas=B, donate=True)
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+
+    def call(carry, start):
+        state, buffers, env_states, obs = carry
+        out = pddpg.rollout_episodes(state, buffers, env_states, obs,
+                                     topo, traffic, jnp.int32(start), chunk)
+        return out[:4]
+
+    t_c = time.time()
+    carry = call((state, buffers, env_states, obs), 0)
+    jax.block_until_ready(carry)
+    compile_s = time.time() - t_c
+    carry = call(carry, chunk)          # warm (donation steady state)
+    jax.block_until_ready(carry)
+    t0 = time.time()
+    for c in range(calls):
+        carry = call(carry, (c + 2) * chunk)
+    jax.block_until_ready(carry)
+    wall = time.time() - t0
+    return {"replicas": B, "chunk": chunk, "max_flows": max_flows,
+            "scan_unroll": unroll,
+            "env_steps_per_sec": round(calls * chunk * B / wall, 1),
+            "per_call_s": round(wall / calls, 3),
+            "compile_s": round(compile_s, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", choices=sorted(GRIDS), default="default")
+    ap.add_argument("--calls", type=int, default=3)
+    ap.add_argument("--episode-steps", type=int, default=200)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:  # same persistent compile cache bench.py uses
+        sys.path.insert(0, __file__.rsplit("/", 2)[0])
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+    except Exception:
+        pass
+
+    rows = []
+    for B, chunk, mf, unroll in GRIDS[args.grid]:
+        try:
+            row = measure(B, chunk, mf, unroll, args.calls,
+                          args.episode_steps)
+        except Exception as e:  # one faulted cell must not kill the sweep
+            row = {"replicas": B, "chunk": chunk, "max_flows": mf,
+                   "scan_unroll": unroll, "error": repr(e)[:200]}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        jax.clear_caches()  # cap live executables/HBM across cells
+    ok = [r for r in rows if "env_steps_per_sec" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["env_steps_per_sec"])
+        print(json.dumps({"winner": best,
+                          "backend": jax.default_backend()}))
+
+
+if __name__ == "__main__":
+    main()

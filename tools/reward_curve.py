@@ -31,7 +31,19 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+_TOOLS = os.path.join(REPO, "tools")
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def no_tpu_env():
+    """A subprocess environment that cannot register the TPU backend —
+    jax-free reference runs and CPU-side children must never block on
+    the shared tunnel.  Single definition; the parity tests import it."""
+    return {k: v for k, v in os.environ.items()
+            if k != "PALLAS_AXON_POOL_IPS"}
 REFERENCE = os.environ.get("GSC_REFERENCE_DIR", "/root/reference")
 NETWORK = "configs/networks/abilene/abilene-in4-rand-cap1-2.graphml"
 SERVICE = "configs/service_functions/abc.yaml"
@@ -42,7 +54,6 @@ SEED = 1234
 def reference_curve(steps):
     """Per-step cumulative (processed, dropped, e2e_sum) from the real
     reference coordsim under the minisimpy shim.  No jax anywhere."""
-    sys.path.insert(0, os.path.join(REPO, "tools"))
     import run_reference
     run_reference._install_shim()
     from siminterface import Simulator
@@ -71,9 +82,13 @@ def uniform_engine_run(network, steps, seed, config=None, overrides=None,
     everywhere.  Shared by tests/test_reference_parity.py (final-metrics
     parity) and the reward-curve anchor (``per_step=True`` captures the
     cumulative counter series) so the two can't desynchronize.  Returns
-    the final SimMetrics, plus the per-step row list when asked."""
+    the final SimMetrics, plus the per-step row list when asked.
+
+    Backend selection is the CALLER's job (conftest pins CPU for tests;
+    this tool's main() pins CPU before dispatch) — a config update here
+    would be a silent no-op in any process whose backend already
+    initialized."""
     import jax
-    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -172,13 +187,14 @@ def main():
     if args.side == "reference":
         print(json.dumps(reference_curve(args.steps)))
         return
+    import jax  # engine/both sides: pin CPU before any backend touch
+    jax.config.update("jax_platforms", "cpu")
     if args.side == "engine":
         print(json.dumps(engine_curve(args.steps)))
         return
 
     # both: reference in a clean subprocess (no jax/TPU registration)
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}
+    env = no_tpu_env()
     r = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--side", "reference",
          "--steps", str(args.steps)],
