@@ -28,7 +28,7 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def run_cell(args, lr, sigma, learn_steps, seed):
+def run_cell(args, lr, sigma, learn_steps, batch_size, seed):
     import jax
 
     from __graft_entry__ import _flagship
@@ -39,7 +39,8 @@ def run_cell(args, lr, sigma, learn_steps, seed):
     T, B, chunk = args.episode_steps, args.replicas, args.chunk
     env, agent, topo, _ = _flagship(episode_steps=T, gen_traffic=False)
     agent = dataclasses.replace(agent, learning_rate=lr, rand_sigma=sigma,
-                                learn_steps=learn_steps)
+                                learn_steps=learn_steps,
+                                batch_size=batch_size)
     env = ServiceCoordEnv(env.service, env.sim_cfg, agent, env.limits)
     dt = DeviceTraffic(env.sim_cfg, env.service, topo, T)
     sample_batch = jax.jit(lambda k: dt.sample_batch(k, B))
@@ -63,6 +64,7 @@ def run_cell(args, lr, sigma, learn_steps, seed):
     k = min(5, max(1, len(returns) // 4))
     return {
         "lr": lr, "sigma": sigma, "learn_steps": learn_steps,
+        "batch_size": batch_size,
         "replicas": B, "episodes": args.episodes, "episode_steps": T,
         "first_k_return": round(sum(returns[:k]) / k, 3),
         "last_k_return": round(sum(returns[-k:]) / k, 3),
@@ -93,6 +95,12 @@ def main():
     ap.add_argument("--grid-learn-steps", type=int, nargs="+",
                     default=[0, 400],
                     help="0 = episode_steps (reference schedule)")
+    ap.add_argument("--grid-batch", type=int, nargs="+", default=[100],
+                    help="critic/actor batch size per learn step — the "
+                    "large-B lever: at B=256 an episode adds 256x the "
+                    "flagship data but the burst length must NOT grow "
+                    "(r4 sweep: 3x burst regresses); scale the batch "
+                    "instead (reference default 100)")
     args = ap.parse_args()
     assert args.episode_steps % args.chunk == 0
 
@@ -103,9 +111,9 @@ def main():
     # a "cell" includes the run shape, so re-sweeping at a different
     # replica count / length into the same file collects fresh data
     # instead of skipping everything
-    def cell_key(lr, sigma, learn_steps):
-        return (lr, sigma, learn_steps, args.replicas, args.episodes,
-                args.episode_steps)
+    def cell_key(lr, sigma, learn_steps, batch):
+        return (lr, sigma, learn_steps, batch, args.replicas,
+                args.episodes, args.episode_steps)
 
     done = set()
     if os.path.exists(args.out):
@@ -113,21 +121,23 @@ def main():
             try:
                 r = json.loads(line)
                 done.add((r["lr"], r["sigma"], r["learn_steps"],
-                          r["replicas"], r["episodes"], r["episode_steps"]))
+                          r.get("batch_size", 100), r["replicas"],
+                          r["episodes"], r["episode_steps"]))
             except (json.JSONDecodeError, KeyError):
                 continue
     cells = list(itertools.product(args.grid_lr, args.grid_sigma,
-                                   args.grid_learn_steps))
-    for lr, sigma, ls in cells:
+                                   args.grid_learn_steps,
+                                   args.grid_batch))
+    for lr, sigma, ls, batch in cells:
         ls_eff = None if ls == 0 else ls
-        if cell_key(lr, sigma, ls_eff) in done \
-                or cell_key(lr, sigma, ls) in done:
+        if cell_key(lr, sigma, ls_eff, batch) in done \
+                or cell_key(lr, sigma, ls, batch) in done:
             print(f"[sweep] skip done cell lr={lr} sigma={sigma} "
-                  f"learn_steps={ls}", file=sys.stderr)
+                  f"learn_steps={ls} batch={batch}", file=sys.stderr)
             continue
-        print(f"[sweep] cell lr={lr} sigma={sigma} learn_steps={ls}",
-              file=sys.stderr)
-        row = run_cell(args, lr, sigma, ls_eff, args.seed)
+        print(f"[sweep] cell lr={lr} sigma={sigma} learn_steps={ls} "
+              f"batch={batch}", file=sys.stderr)
+        row = run_cell(args, lr, sigma, ls_eff, batch, args.seed)
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
         print(json.dumps(row))
