@@ -1,0 +1,83 @@
+"""Round-5 chip-window runner: wait for the axon tunnel, then execute
+the prioritized measurement queue the moment it answers.
+
+Complements ``tpu_validate.py`` (the round-4 validation queue, already
+banked this round): this is the ROUND-5 plan — lever sweep toward the
+20x bar first, then the sustained-learning exhibit, the on-chip MFU
+table, and the remaining skipped validation stages.  Stages reuse
+tpu_validate's bounded-subprocess framework (a faulted stage cannot
+wedge the parent; results bank incrementally to CHIP_WINDOW.json, and
+after any failed stage the backend is re-probed before spending the
+next stage's timeout).
+
+    python tools/chip_window.py               # wait + run
+    python tools/chip_window.py --no-wait     # probe once, run or exit
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_validate import _probe, run_queue  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# banked separately from TPU_VALIDATION.json (the round-4 artifact this
+# round already banked)
+OUT = os.path.join(REPO, "CHIP_WINDOW.json")
+
+
+def stages(py):
+    t = os.path.join(REPO, "tools")
+    return [
+        # 1. the 20x push: measure the lever grid (scan_unroll x
+        #    max_flows x B); winner feeds bench knobs
+        ("lever_sweep", [py, os.path.join(t, "lever_sweep.py")], 3000),
+        # 2. sustained learning at the throughput config (the r4 queue's
+        #    failed stage): wall rate vs device rate + learning exhibit
+        ("learning", [py, os.path.join(t, "learning_curve.py"),
+                      "--replicas", "256", "--episodes", "12"], 3000),
+        # 3. on-chip MFU/roofline (refines the static table in
+        #    BENCH_NOTES)
+        ("mfu", [py, os.path.join(t, "profile_substep.py"), "--mfu",
+                 "--replicas", "64", "256", "512"], 1800),
+        # 4. remaining r4 validation stages skipped on the wedged chip
+        ("gnn_bench", [py, os.path.join(t, "gnn_bench.py")], 900),
+        ("rung5", [py, os.path.join(REPO, "bench.py"), "--worker",
+                   "32", "10", "1", "rung5"], 2400),
+        # 5. on-chip anchor scoring (fast; non-learned rows only — the
+        #    learned row rides the CPU checkpoint table)
+        ("anchors", [py, os.path.join(t, "quality_anchor.py"),
+                     "--replicas", "64", "--episodes", "2"], 1800),
+    ]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-wait", action="store_true")
+    ap.add_argument("--poll-s", type=int, default=420)
+    ap.add_argument("--max-wait-s", type=int, default=6 * 3600)
+    args = ap.parse_args()
+    py = sys.executable
+
+    t0 = time.time()
+    while not _probe(py):
+        if args.no_wait or time.time() - t0 > args.max_wait_s:
+            print("tunnel never answered", file=sys.stderr)
+            sys.exit(1)
+        print(f"[wait] tunnel down {round(time.time() - t0)}s; "
+              f"next probe in {args.poll_s}s", file=sys.stderr)
+        time.sleep(args.poll_s)
+    print(f"[wait] tunnel UP after {round(time.time() - t0)}s — running "
+          f"the round-5 queue", file=sys.stderr)
+
+    results = {}
+    run_queue(stages(py), results, out_path=OUT, py=py)
+    print(json.dumps({k: v.get("ok") for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,8 +62,11 @@ assert rel < 5e-2, (diff, rel)
 PROBE_CODE = "import jax; print(jax.devices())"
 
 
-def _save(results):
-    with open(os.path.join(REPO, "TPU_VALIDATION.json"), "w") as f:
+DEFAULT_OUT = os.path.join(REPO, "TPU_VALIDATION.json")
+
+
+def _save(results, out_path=None):
+    with open(out_path or DEFAULT_OUT, "w") as f:
         json.dump(results, f, indent=1)
 
 
@@ -75,7 +78,7 @@ def _text(raw):
     return raw or ""
 
 
-def run_stage(name, cmd, timeout, results):
+def run_stage(name, cmd, timeout, results, out_path=None):
     t0 = time.time()
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -95,7 +98,7 @@ def run_stage(name, cmd, timeout, results):
                      "stdout_tail": out, "stderr_tail": err}
     print(f"[{name}] {'OK' if ok else 'FAIL'} "
           f"({results[name]['wall_s']}s)", file=sys.stderr)
-    _save(results)
+    _save(results, out_path)
     return ok
 
 
@@ -106,6 +109,24 @@ def _probe(py, timeout=240):
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def run_queue(stages, results, out_path=None, py=None):
+    """Run bounded-subprocess stages with the probe-skip-bank protocol:
+    after a FAILED stage, re-probe instead of burning each remaining
+    stage's full timeout on a wedged backend.  Shared by this round-4
+    validation queue and tools/chip_window.py (round-5 queue)."""
+    py = py or sys.executable
+    prev_ok = True
+    for name, cmd, timeout in stages:
+        if not prev_ok and not _probe(py):
+            results[name] = {"ok": False, "skipped":
+                             "backend unhealthy after previous stage"}
+            print(f"[{name}] SKIP (backend unhealthy)", file=sys.stderr)
+            _save(results, out_path)
+            continue
+        prev_ok = run_stage(name, cmd, timeout, results, out_path)
+    return results
 
 
 def main():
@@ -131,19 +152,7 @@ def main():
         ("rung5", [py, os.path.join(REPO, "bench.py"), "--worker",
                    "32", "10", "1", "rung5"], 2400),
     ]
-    prev_ok = True
-    for name, cmd, timeout in stages:
-        # a faulted stage wedges the shared chip and every later process
-        # hangs at backend init — after a FAILED stage, re-probe instead
-        # of burning each remaining stage's full timeout discovering that
-        # (healthy-path runs pay no extra backend inits)
-        if not prev_ok and not _probe(py):
-            results[name] = {"ok": False, "skipped":
-                             "backend unhealthy after previous stage"}
-            print(f"[{name}] SKIP (backend unhealthy)", file=sys.stderr)
-            _save(results)
-            continue
-        prev_ok = run_stage(name, cmd, timeout, results)
+    run_queue(stages, results)
     print(json.dumps(results.get("bench", {}), indent=1))
 
 
