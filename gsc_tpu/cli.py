@@ -353,8 +353,15 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
 @click.option("--max-edges", default=37, show_default=True)
 @click.option("--resource-functions-path", default=None,
               help="dir (or .py file) of user resource-function plugins")
+@click.option("--per-flow-algo", type=click.Choice(["local", "spr"]),
+              default="local", show_default=True,
+              help="per-flow decision algorithm when the simulator config "
+              "sets controller: per_flow — 'local' processes every flow at "
+              "its current node (jitted policy); 'spr' runs the "
+              "shortest-path heuristic through the host-side "
+              "PerFlowController (the reference's FlowController loop)")
 def simulate(duration, network, service, config, seed, max_nodes, max_edges,
-             resource_functions_path):
+             resource_functions_path, per_flow_algo):
     """Standalone simulator run with a uniform schedule over all nodes and
     every SF placed everywhere — the smoke-run mode of coordsim/main.py:19-89
     (which uses hard-coded dummy placement/schedule tables)."""
@@ -385,20 +392,38 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     nm = np.asarray(topo.node_mask)
     n_real = int(nm.sum())
     state = engine.init(jax.random.PRNGKey(seed), topo)
+    if per_flow_algo != "local" and sim_cfg.controller != "per_flow":
+        raise click.BadParameter(
+            f"--per-flow-algo {per_flow_algo} requires 'controller: "
+            "per_flow' in the simulator config (this config runs the "
+            "duration controller, which would silently ignore the "
+            "algorithm)")
     if sim_cfg.controller == "per_flow":
         # FlowController granularity (flow_controller.py:21-92): each
-        # deciding flow gets an individual destination every substep.  The
-        # smoke-run policy processes locally (place-on-decision installs the
-        # SF at the flow's node); idle instances are GC'd after vnf_timeout.
-        from .sim.state import PH_DECIDE
+        # deciding flow gets an individual destination every substep.
+        if per_flow_algo == "spr":
+            # host-side external algorithm through PerFlowController —
+            # the loop a reference user writes against
+            # FlowController.get_init_state/get_next_state
+            from .sim.perflow import PerFlowController
+            from .sim.spr import run_spr_episode
 
-        def decide_local(st):
-            deciding = st.flows.phase == PH_DECIDE
-            return jnp.where(deciding, st.flows.node, -1)
+            ctrl = PerFlowController(engine, topo, traffic)
+            state = run_spr_episode(ctrl, state, steps * engine.substeps)
+            metrics = state.metrics
+        else:
+            # jitted local policy: process at the flow's node
+            # (place-on-decision installs the SF; idle instances are
+            # GC'd after vnf_timeout)
+            from .sim.state import PH_DECIDE
 
-        for _ in range(steps):
-            state, metrics = engine.apply_per_flow(state, topo, traffic,
-                                                   decide_local)
+            def decide_local(st):
+                deciding = st.flows.phase == PH_DECIDE
+                return jnp.where(deciding, st.flows.node, -1)
+
+            for _ in range(steps):
+                state, metrics = engine.apply_per_flow(state, topo, traffic,
+                                                       decide_local)
     else:
         sched = np.zeros(limits.scheduling_shape, np.float32)
         sched[:, :, :, nm] = 1.0 / n_real

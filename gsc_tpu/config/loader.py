@@ -114,6 +114,14 @@ def load_sim(path: str, **overrides) -> SimConfig:
         kw["controller"] = {"DurationController": "duration",
                             "FlowController": "per_flow"}.get(
             cfg["controller_class"], cfg["controller_class"])
+    if "controller" in cfg:
+        # the rebuild's native spelling; silently ignoring it would make
+        # `controller: per_flow` run the duration controller
+        if "controller_class" in cfg and kw["controller"] != cfg["controller"]:
+            raise ValueError(
+                f"conflicting controller_class={cfg['controller_class']!r} "
+                f"and controller={cfg['controller']!r} in {path}")
+        kw["controller"] = cfg["controller"]
     kw.update(overrides)
     return SimConfig(**kw)
 

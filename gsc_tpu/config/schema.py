@@ -188,6 +188,12 @@ class SimConfig:
             raise ValueError("run_duration and dt must be positive")
         if not self.ttl_choices:
             raise ValueError("TTL must be set in config file")  # simulatorparams.py:41
+        if self.controller not in ("duration", "per_flow"):
+            raise ValueError(
+                f"unknown controller {self.controller!r} (expected "
+                "'duration' or 'per_flow'; reference spellings "
+                "DurationController/FlowController are mapped by the "
+                "loader)")
 
     @property
     def substeps_per_run(self) -> int:
