@@ -17,9 +17,14 @@ fault at one rung never poisons the artifact: the previous rung's number is
 already banked.
 
 Episodes run CHUNKED: the 200-step episode executes as several shorter
-``rollout_episodes`` device calls (carrying env state/obs/replay across
-calls).  Single 200-step scan calls (200 x 100 fused engine substeps) fault
-the TPU runtime; 25-50-step chunks are the validated operating range.
+device calls (carrying env state/obs/replay across calls).  Single 200-step
+scan calls (200 x 100 fused engine substeps) fault the TPU runtime;
+25-50-step chunks are the validated operating range.  By default the
+ASYNC PIPELINE path runs: every chunk is a fused ``chunk_step`` (the final
+one carrying the learn burst in the same program) and episode k's metric
+sync is deferred until after episode k+1's dispatch.  ``--pipeline off``
+(or GSC_BENCH_PIPELINE=0) restores the seed's two-call-per-episode shape
+so a pair of runs attributes the pipeline's share of the throughput.
 
 Baseline: the reference publishes no numbers (BASELINE.md); its training
 loop is a single SimPy env + torch DDPG on one CPU core
@@ -82,18 +87,12 @@ def _env_int(name: str, default: int) -> int:
         raise SystemExit(f"{name}={raw!r} is not an integer")
 
 
-def _knobs() -> dict:
-    """Effective lever-sweep knobs (tools/lever_sweep.py winners).  Echoed
-    into every measurement line and the final artifact: a knob-modified
-    workload must never be indistinguishable from a default run."""
-    k = {}
-    mf = _env_int("GSC_BENCH_MAX_FLOWS", 128)
-    if mf != 128:
-        k["max_flows"] = mf
-    unroll = _env_int("GSC_BENCH_SCAN_UNROLL", 0)
-    if unroll:
-        k["scan_unroll"] = unroll
-    return k
+def _pipeline_enabled() -> bool:
+    """Fused rollout+learn dispatch with deferred metric banking
+    (ParallelDDPG.chunk_step).  Default ON — it is the product training
+    loop; GSC_BENCH_PIPELINE=0 restores the two-call-per-episode path so a
+    row can attribute the pipeline's share of the throughput."""
+    return _env_int("GSC_BENCH_PIPELINE", 1) != 0
 
 
 def baseline_sps() -> float:
@@ -197,7 +196,12 @@ def orchestrate():
             # therefore conservative
             "baseline_sps": denom,
             "baseline_scope": "reference env-physics only (no torch agent)",
-            **({"knobs": _knobs()} if _knobs() else {}),
+            "pipeline": b.get("pipeline", True),
+            # knobs come from the WORKER's banked row — derived from the
+            # values it actually passed to its stack builder (ADVICE r5:
+            # the old env-var echo tagged rung4/rung5/interroute rows with
+            # a max_flows knob those stacks hardcode away)
+            **({"knobs": b["knobs"]} if b.get("knobs") else {}),
         })
 
     best_clean = False   # a PARTIAL (timed-out/faulted) result must not
@@ -354,21 +358,32 @@ def worker(replicas: int, chunk: int, episodes: int,
     assert EPISODE_STEPS % chunk == 0, (EPISODE_STEPS, chunk)
     chunks_per_ep = EPISODE_STEPS // chunk
     t_start = time.time()
+    # knobs are derived from the values ACTUALLY passed to the stack
+    # builder below (ADVICE r5): max_flows only reaches the flagship
+    # builder — rung4/rung5/interroute hardcode their own flow tables, so
+    # tagging their rows with the env var would be a lie
+    knobs = {}
+    pipeline = _pipeline_enabled()   # every row carries "pipeline" at top
+    # level — not duplicated into knobs
     if scenario in STACKS:
         env, agent, topo = STACKS[scenario](EPISODE_STEPS)
     else:
         # lever-sweep winner knobs (tools/lever_sweep.py): opt-in via env
         # vars so the official artifact path can adopt a measured winner
         # without a code edit; unset = exact previous behavior
+        mf = _env_int("GSC_BENCH_MAX_FLOWS", 128)
+        if mf != 128:
+            knobs["max_flows"] = mf
         env, agent, topo, _ = _flagship(
-            episode_steps=EPISODE_STEPS,
-            max_flows=_env_int("GSC_BENCH_MAX_FLOWS", 128),
-            gen_traffic=False)
+            episode_steps=EPISODE_STEPS, max_flows=mf, gen_traffic=False)
     unroll = _env_int("GSC_BENCH_SCAN_UNROLL", 0)
     if unroll:
         import dataclasses
 
         from gsc_tpu.env.env import ServiceCoordEnv
+        # scan_unroll rebuilds the env for EVERY scenario, so the knob
+        # legitimately tags all rows
+        knobs["scan_unroll"] = unroll
         env = ServiceCoordEnv(
             env.service,
             dataclasses.replace(env.sim_cfg, scan_unroll=unroll),
@@ -387,13 +402,52 @@ def worker(replicas: int, chunk: int, episodes: int,
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
 
+    from gsc_tpu.utils.telemetry import PhaseTimer
+    timer = PhaseTimer()
+
     def episode(state, buffers, env_states, obs, ep):
-        for c in range(chunks_per_ep):
-            start = jnp.int32(ep * EPISODE_STEPS + c * chunk)
-            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
-                state, buffers, env_states, obs, topo, traffic, start, chunk)
-        state, metrics = pddpg.learn_burst(state, buffers)
+        """Dispatch one full episode's device work (async).  Pipelined:
+        every chunk goes through the fused chunk_step, the LAST one with
+        learn=True — rollout tail and learn burst in one program.  Off:
+        the seed's two-call shape (per-chunk rollout + separate learn)."""
+        with timer.phase("dispatch"):
+            for c in range(chunks_per_ep):
+                start = jnp.int32(ep * EPISODE_STEPS + c * chunk)
+                if pipeline:
+                    state, buffers, env_states, obs, stats, metrics = \
+                        pddpg.chunk_step(state, buffers, env_states, obs,
+                                         topo, traffic, start, chunk,
+                                         learn=(c == chunks_per_ep - 1))
+                else:
+                    state, buffers, env_states, obs, stats = \
+                        pddpg.rollout_episodes(state, buffers, env_states,
+                                               obs, topo, traffic, start,
+                                               chunk)
+            if not pipeline:
+                state, metrics = pddpg.learn_burst(state, buffers)
         return state, buffers, env_states, obs, stats, metrics
+
+    def bank(ep, out, t0):
+        """Sync one episode's metrics and print its running rate: if a
+        later episode faults or outlives the rung timeout, the
+        orchestrator still parses the best partial line.  Only the stats/
+        learn-metrics leaves are blocked on — the carries may already have
+        been DONATED into the next episode's dispatch (the pipeline's
+        whole point), and they finish in the same program anyway."""
+        with timer.phase("drain"):
+            jax.block_until_ready(out[4:])
+        dt = time.time() - t0
+        sps = ep * EPISODE_STEPS * B / dt
+        print(json.dumps({
+            "metric": "env_steps_per_sec_per_chip",
+            "value": round(sps, 1),
+            "unit": "env-steps/s",
+            "replicas": B, "chunk": chunk, "scenario": scenario,
+            "pipeline": pipeline,
+            "episodes_measured": ep,
+            "measure_wall_s": round(dt, 1),
+            **({"knobs": knobs} if knobs else {}),
+        }), flush=True)
 
     # warmup/compile (episode 0 is also the agent's random-action warmup)
     out = episode(state, buffers, env_states, obs, 0)
@@ -403,29 +457,49 @@ def worker(replicas: int, chunk: int, episodes: int,
           file=sys.stderr)
 
     t0 = time.time()
-    for ep in range(1, 1 + episodes):
-        out = episode(state, buffers, env_states, obs, ep)
-        state, buffers, env_states, obs = out[:4]
-        # bank a rate after EVERY measured episode (forcing completion
-        # first): if a later episode faults or outlives the rung timeout,
-        # the orchestrator still parses the best partial line
-        jax.block_until_ready(out)
-        dt = time.time() - t0
-        sps = ep * EPISODE_STEPS * B / dt
-        print(json.dumps({
-            "metric": "env_steps_per_sec_per_chip",
-            "value": round(sps, 1),
-            "unit": "env-steps/s",
-            "replicas": B, "chunk": chunk, "scenario": scenario,
-            "episodes_measured": ep,
-            "measure_wall_s": round(dt, 1),
-            **({"knobs": _knobs()} if _knobs() else {}),
-        }), flush=True)
+    prev = None   # pipelined: episode k's metric sync happens AFTER
+    # episode k+1's dispatch, so the chip rolls straight into the next
+    # episode while the host banks the previous rate
+    try:
+        for ep in range(1, 1 + episodes):
+            out = episode(state, buffers, env_states, obs, ep)
+            state, buffers, env_states, obs = out[:4]
+            if pipeline:
+                if prev is not None:
+                    bank(*prev, t0)
+                    prev = None
+                prev = (ep, out)
+            else:
+                bank(ep, out, t0)
+    finally:
+        # a fault during episode k's dispatch must not drop episode k-1's
+        # already-earned measurement line — the orchestrator's recovered
+        # partial rate is parsed from the banked tail.  Best effort: a
+        # bank that itself fails (wedged backend) must not mask the
+        # original fault's traceback or hang past it.
+        if prev is not None:
+            try:
+                bank(*prev, t0)
+            except Exception as e:
+                print(f"[worker] could not bank episode {prev[0]} after "
+                      f"fault: {e!r}", file=sys.stderr)
+        print(f"[worker] phase timings: {json.dumps(timer.summary())}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        worker(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
-               sys.argv[5] if len(sys.argv) > 5 else "flagship")
+    argv = list(sys.argv[1:])
+    if "--pipeline" in argv:
+        # orchestrator-level knob: forwarded to worker subprocesses via the
+        # environment so every ladder rung measures the same dispatch shape
+        i = argv.index("--pipeline")
+        mode = argv[i + 1] if i + 1 < len(argv) else "on"
+        if mode not in ("on", "off"):
+            raise SystemExit(f"--pipeline expects on|off, got {mode!r}")
+        os.environ["GSC_BENCH_PIPELINE"] = "1" if mode == "on" else "0"
+        del argv[i:i + 2]
+    if argv and argv[0] == "--worker":
+        worker(int(argv[1]), int(argv[2]), int(argv[3]),
+               argv[4] if len(argv) > 4 else "flagship")
     else:
         orchestrate()

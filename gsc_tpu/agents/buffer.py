@@ -92,6 +92,15 @@ def buffer_add(buf: ReplayBuffer, item: Any) -> ReplayBuffer:
                         shapes=buf.shapes)
 
 
+def buffer_nbytes(buf: ReplayBuffer) -> int:
+    """Total replay storage footprint in bytes.  The buffer is the largest
+    HBM resident of a training run; the pipeline telemetry logs this so the
+    copy traffic that ``donate_argnums`` eliminates (one full-buffer copy
+    per episode on the non-donating path) is attributable."""
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(buf.data))
+
+
 def buffer_sample(buf: ReplayBuffer, key, batch_size: int) -> Any:
     """Uniform sample of ``batch_size`` transitions (buffer.py:56-67),
     restored to original per-transition shapes."""

@@ -5,8 +5,10 @@ CleanRL-style DDPG: one actor, one critic, Polyak-averaged targets, Adam.
 The reference steps the env and nets one Python call at a time on CPU; here
 a whole episode's rollout is one ``lax.scan`` (actions, env physics, replay
 writes all on device) and the end-of-episode learning burst is one
-``lax.fori_loop`` of ``episode_steps`` gradient steps (simple_ddpg.py:307-325)
-— two device calls per episode in total.
+``lax.fori_loop`` of ``episode_steps`` gradient steps (simple_ddpg.py:307-325).
+The pipelined trainer fuses both into ONE device call per episode
+(``episode_step``); the two-call path (``rollout_episode`` + ``learn_burst``)
+remains for chunked/serial drivers and is bit-identical.
 
 Faithful semantics:
 - warmup (< nb_steps_warmup_critic global steps): uniform random action
@@ -53,13 +55,33 @@ class DDPGState:
     rng: jnp.ndarray
 
 
+def donated_jit(bound_self, method, static_argnums, donate_argnums):
+    """Per-instance re-jit of a jitted method with buffer donation (the
+    ParallelDDPG ``donate=True`` pattern, shared by both agent paths).
+    Callers must treat the donated arguments as CONSUMED — always rebind
+    from the return; comparison-style double-calls on the same inputs must
+    construct the agent with the non-donating default."""
+    fn = getattr(method, "__wrapped__", method)
+    return partial(jax.jit(fn, static_argnums=static_argnums,
+                           donate_argnums=donate_argnums), bound_self)
+
+
 class DDPG:
-    """Factory closing over static config; all methods are pure and jitted."""
+    """Factory closing over static config; all methods are pure and jitted.
+
+    ``donate=True`` aliases the large carried pytrees into their device
+    calls so XLA updates them in place instead of copying every episode:
+    the replay buffer (the largest HBM resident) and env-state carry are
+    donated into the rollout, and the learner state into the learn burst /
+    fused episode step.  ``obs`` is never donated (its leaves can alias
+    env-state or topology buffers — double donation, which XLA rejects).
+    """
 
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
-                 gnn_impl: str = None):
+                 gnn_impl: str = None, donate: bool = False):
         self.env = env
         self.agent = agent
+        self.donate = donate
         self.action_dim = env.limits.action_dim
         gnn_impl = gnn_impl or agent.gnn_impl  # config-selected embedder
         sched_shape = env.limits.scheduling_shape
@@ -69,6 +91,17 @@ class DDPG:
                                action_dim=self.action_dim,
                                sched_shape=sched_shape)
         self.opt = optax.adam(agent.learning_rate)
+        if donate:
+            cls = type(self)
+            self.rollout_episode = donated_jit(
+                self, cls.rollout_episode, static_argnums=(0, 8),
+                donate_argnums=(2, 3))
+            self.learn_burst = donated_jit(
+                self, cls.learn_burst, static_argnums=(0,),
+                donate_argnums=(1,))
+            self.episode_step = donated_jit(
+                self, cls.episode_step, static_argnums=(0, 8, 9),
+                donate_argnums=(1, 2, 3))
 
     # ---------------------------------------------------------------- init
     def init(self, rng, sample_obs) -> DDPGState:
@@ -76,11 +109,17 @@ class DDPG:
         actor_params = self.actor.init(k1, sample_obs)
         critic_params = self.critic.init(
             k2, sample_obs, jnp.zeros(self.action_dim))
+        # fresh init shares the target trees' device buffers with the online
+        # trees; under donation that is a double donation of the same buffer
+        # (XLA rejects it), so break the aliasing with a one-time copy
+        copy = (jax.tree_util.tree_map(jnp.copy, (actor_params,
+                                                  critic_params))
+                if self.donate else (actor_params, critic_params))
         return DDPGState(
             actor_params=actor_params,
             critic_params=critic_params,
-            target_actor_params=actor_params,
-            target_critic_params=critic_params,
+            target_actor_params=copy[0],
+            target_critic_params=copy[1],
             actor_opt=self.opt.init(actor_params),
             critic_opt=self.opt.init(critic_params),
             rng=k3,
@@ -118,18 +157,14 @@ class DDPG:
         return jax.lax.cond(warmup, lambda: random_action, policy_action)
 
     # ------------------------------------------------------------- rollout
-    @partial(jax.jit, static_argnums=(0, 8))
-    def rollout_episode(self, state: DDPGState, buffer: ReplayBuffer,
-                        env_state, obs, topo, traffic,
-                        episode_start_step: jnp.ndarray,
-                        num_steps: int = None
-                        ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
-                                   Dict[str, jnp.ndarray]]:
-        """One full episode as a lax.scan: action -> env.step -> buffer.add.
-        Returns (state w/ fresh rng, buffer, final_env_state, final_obs,
-        episode stats).  ``num_steps`` (static) overrides the scan length so
-        an episode can run as several shorter device calls (see
-        ParallelDDPG.rollout_episodes for the chunking contract)."""
+    def _rollout_body(self, state: DDPGState, buffer: ReplayBuffer,
+                      env_state, obs, topo, traffic,
+                      episode_start_step: jnp.ndarray,
+                      num_steps: int = None
+                      ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
+                                 Dict[str, jnp.ndarray]]:
+        """Rollout scan shared by ``rollout_episode`` and the fused
+        ``episode_step`` (traced inside their jits, never called raw)."""
         from ..env.actions import action_mask
         from ..env.permutation import ShuffleOps
         mask = action_mask(topo.node_mask, self.env.limits.num_sfcs,
@@ -171,6 +206,51 @@ class DDPG:
             "final_succ_ratio": stats["succ_ratio"][-1],
         }
         return state.replace(rng=rng), buffer, env_state, obs, episode_stats
+
+    @partial(jax.jit, static_argnums=(0, 8))
+    def rollout_episode(self, state: DDPGState, buffer: ReplayBuffer,
+                        env_state, obs, topo, traffic,
+                        episode_start_step: jnp.ndarray,
+                        num_steps: int = None
+                        ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
+                                   Dict[str, jnp.ndarray]]:
+        """One full episode as a lax.scan: action -> env.step -> buffer.add.
+        Returns (state w/ fresh rng, buffer, final_env_state, final_obs,
+        episode stats).  ``num_steps`` (static) overrides the scan length so
+        an episode can run as several shorter device calls (see
+        ParallelDDPG.rollout_episodes for the chunking contract)."""
+        return self._rollout_body(state, buffer, env_state, obs, topo,
+                                  traffic, episode_start_step, num_steps)
+
+    @partial(jax.jit, static_argnums=(0, 8, 9))
+    def episode_step(self, state: DDPGState, buffer: ReplayBuffer,
+                     env_state, obs, topo, traffic,
+                     episode_start_step: jnp.ndarray,
+                     num_steps: int = None, learn: bool = False
+                     ) -> Tuple["DDPGState", ReplayBuffer, Any, Any,
+                                Dict[str, jnp.ndarray],
+                                Dict[str, jnp.ndarray]]:
+        """Fused rollout + learn: one device program per episode.
+
+        Runs the chunked rollout scan and — when ``learn`` (static; the
+        host decides it from the warmup schedule, which depends only on the
+        episode index) — the end-of-episode learn burst in the SAME jitted
+        call, eliminating the host round-trip between the two dispatches
+        and letting XLA overlap the tail of the scan with the first
+        gradient steps.  Returns (state, buffer, env_state, obs, stats,
+        learn_metrics) with ``learn_metrics=None`` during warmup.  The op
+        sequence is identical to ``rollout_episode`` followed by
+        ``learn_burst``, so results are bit-identical to the two-call
+        path."""
+        state, buffer, env_state, obs, stats = self._rollout_body(
+            state, buffer, env_state, obs, topo, traffic,
+            episode_start_step, num_steps)
+        metrics = None
+        if learn:
+            state, metrics = self._learn_burst(
+                state,
+                lambda k: buffer_sample(buffer, k, self.agent.batch_size))
+        return state, buffer, env_state, obs, stats, metrics
 
     # ------------------------------------------------------------ learning
     def _critic_loss(self, critic_params, state: DDPGState, batch):

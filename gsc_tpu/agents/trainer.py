@@ -2,9 +2,20 @@
 
 The analogue of SimpleDDPG.train + the experiment plumbing of
 src/rlsp/agents/main.py: per episode it picks the scheduled topology,
-samples traffic (host), then issues exactly two device calls — a full-episode
-rollout scan and a learn burst — and logs episode metrics (rewards.csv like
-result_writer.py:6-38, optional TensorBoard like simple_ddpg.py:165-174).
+samples traffic (host), dispatches the episode's device work, and logs
+episode metrics (rewards.csv like result_writer.py:6-38, optional
+TensorBoard like simple_ddpg.py:165-174).
+
+The default ``pipeline=True`` path keeps the accelerator saturated between
+episodes (Podracer-style, arXiv:2104.06272): a background thread PREFETCHES
+episode k+1's topology/traffic (staged to device) while episode k runs, the
+rollout scan and learn burst run as ONE fused jitted ``episode_step`` (no
+host round-trip between them), per-episode metric syncs are DEFERRED one
+episode so ``np.asarray`` never gates the next dispatch, and the replay
+buffer / env-state carries are donated (updated in place in HBM instead of
+copied every episode).  Results are bit-identical to the serial path —
+per-episode PRNG streams are ``fold_in``-keyed by the episode index, so
+look-ahead cannot perturb them and exact resume is preserved.
 """
 from __future__ import annotations
 
@@ -15,6 +26,7 @@ import time
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config.schema import AgentConfig
@@ -52,13 +64,22 @@ class Trainer:
     def __init__(self, env: ServiceCoordEnv, driver: EpisodeDriver,
                  agent_cfg: AgentConfig, seed: int = 0,
                  result_dir: Optional[str] = None,
-                 tensorboard: bool = False, gnn_impl: str = None):
+                 tensorboard: bool = False, gnn_impl: str = None,
+                 donate: bool = True):
         self.env = env
         self.driver = driver
         self.agent_cfg = agent_cfg
         self.seed = seed
-        self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl)
+        # donation is on by default: the training loops always rebind the
+        # carries from the kernel returns, so in-place HBM updates of the
+        # replay/env-state are safe; pass donate=False for comparison
+        # drivers that re-call kernels on the same inputs
+        self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl, donate=donate)
         self.result_dir = result_dir
+        # per-phase host wall timings of the last train() call
+        # (utils.telemetry.PhaseTimer) — how much host time hid behind
+        # device compute; populated by train(), logged at loop end
+        self.phase_timer = None
         self.rewards_writer = RewardsWriter(
             os.path.join(result_dir, "rewards.csv") if result_dir else None)
         self.tb = None
@@ -91,58 +112,24 @@ class Trainer:
                 self.tb.add_scalar("losses/qf1_values", row["q_values"],
                                    global_step)
 
-    def train(self, episodes: int, test_mode: bool = False,
-              verbose: bool = False, profile: bool = False,
-              init_state: Optional[DDPGState] = None,
-              init_buffer=None, start_episode: int = 0):
-        """Train through episode ``episodes - 1`` (train-at-episode-end
-        schedule, simple_ddpg.py:280-329).  Returns (final learner state,
-        replay buffer).  With ``profile`` a jax profiler trace of the run is
-        written to <result_dir>/profile (SURVEY.md §5 tracing analogue).
-
-        Exact resume: pass a restored (``init_state``, ``init_buffer``,
-        ``start_episode``) triple and the continuation reproduces an
-        uninterrupted run bit-for-bit — per-episode keys derive from
-        ``fold_in(seed, episode)`` rather than a sequential split chain, so
-        the host-side stream needs no replay (the device-side stream lives
-        in DDPGState.rng, which the checkpoint carries).  The reference
-        cannot do this: it never saves optimizer or replay state
-        (main.py:46-50, SURVEY.md §5)."""
-        if profile and self.result_dir:
-            from ..utils.debug import Profiler
-            with Profiler(os.path.join(self.result_dir, "profile")):
-                return self.train(episodes, test_mode, verbose,
-                                  profile=False, init_state=init_state,
-                                  init_buffer=init_buffer,
-                                  start_episode=start_episode)
-        base = jax.random.PRNGKey(self.seed)
-        steps_per_ep = self.agent_cfg.episode_steps
-
-        topo, traffic = self.driver.episode(start_episode, test_mode)
-        env_state, obs = self.env.reset(
-            jax.random.fold_in(base, 1000 + start_episode), topo, traffic)
-        state = init_state if init_state is not None else \
-            self.ddpg.init(jax.random.fold_in(base, 0), obs)
-        buffer = init_buffer if init_buffer is not None else \
-            self.ddpg.init_buffer(obs)
-
-        start = time.time()
-        for ep in range(start_episode, episodes):
-            if ep > start_episode:
-                topo, traffic = self.driver.episode(ep, test_mode)
-                env_state, obs = self.env.reset(
-                    jax.random.fold_in(base, 1000 + ep), topo, traffic)
-            global_step = ep * steps_per_ep
-            state, buffer, env_state, obs, stats = self.ddpg.rollout_episode(
-                state, buffer, env_state, obs, topo, traffic,
-                np.int32(global_step))
-            learn_metrics = None
-            end_step = global_step + steps_per_ep - 1
-            if end_step >= self.agent_cfg.nb_steps_warmup_critic - 1:
-                state, learn_metrics = self.ddpg.learn_burst(state, buffer)
+    def _drain(self, entry, start_time: float, start_episode: int,
+               verbose: bool, timer):
+        """Sync one pending episode's device metrics to host and log it.
+        On the pipelined path this runs one episode BEHIND the dispatch
+        head, so the ``np.asarray`` syncs here wait on device work that has
+        already been followed by the next episode's dispatch — the chip
+        never idles on host-side logging."""
+        ep, end_step, stats, learn_metrics, trunc_dev = entry
+        with timer.phase("drain"):
+            # force the episode's device work complete BEFORE reading the
+            # wall clock: sps must divide by time that includes the
+            # episode's compute (bench.py's bank() contract), not the
+            # async-dispatch return time
+            jax.block_until_ready((stats, learn_metrics, trunc_dev))
+            steps_per_ep = self.agent_cfg.episode_steps
             sps = ((ep - start_episode + 1) * steps_per_ep
-                   / (time.time() - start))
-            trunc = int(np.asarray(env_state.sim.truncated_arrivals))
+                   / (time.time() - start_time))
+            trunc = int(np.asarray(trunc_dev))
             if trunc > 0:
                 # overload: the flow table (or per-substep arrival budget)
                 # saturated, so some arrivals spawned late — generated-flow
@@ -160,6 +147,158 @@ class Trainer:
                     "episode=%d return=%.3f succ=%.3f sps=%.1f", ep,
                     float(np.asarray(stats["episodic_return"])),
                     float(np.asarray(stats["mean_succ_ratio"])), sps)
+
+    def train(self, episodes: int, test_mode: bool = False,
+              verbose: bool = False, profile: bool = False,
+              init_state: Optional[DDPGState] = None,
+              init_buffer=None, start_episode: int = 0,
+              pipeline: bool = True):
+        """Train through episode ``episodes - 1`` (train-at-episode-end
+        schedule, simple_ddpg.py:280-329).  Returns (final learner state,
+        replay buffer).  With ``profile`` a jax profiler trace of the run is
+        written to <result_dir>/profile (SURVEY.md §5 tracing analogue).
+
+        ``pipeline=True`` (default) runs the asynchronous episode pipeline:
+        prefetched host traffic, one fused rollout+learn device call per
+        episode, and metric draining deferred one episode behind dispatch.
+        ``pipeline=False`` is the serial reference loop (two device calls
+        per episode, synced logging) — results are bit-identical either
+        way; the flag only changes host/device scheduling.
+
+        Exact resume: pass a restored (``init_state``, ``init_buffer``,
+        ``start_episode``) triple and the continuation reproduces an
+        uninterrupted run bit-for-bit — per-episode keys derive from
+        ``fold_in(seed, episode)`` rather than a sequential split chain, so
+        the host-side stream needs no replay (the device-side stream lives
+        in DDPGState.rng, which the checkpoint carries).  The reference
+        cannot do this: it never saves optimizer or replay state
+        (main.py:46-50, SURVEY.md §5)."""
+        if profile and self.result_dir:
+            from ..utils.debug import Profiler
+            with Profiler(os.path.join(self.result_dir, "profile")):
+                return self.train(episodes, test_mode, verbose,
+                                  profile=False, init_state=init_state,
+                                  init_buffer=init_buffer,
+                                  start_episode=start_episode,
+                                  pipeline=pipeline)
+        from ..utils.telemetry import PhaseTimer
+        self.phase_timer = timer = PhaseTimer()
+        base = jax.random.PRNGKey(self.seed)
+        steps_per_ep = self.agent_cfg.episode_steps
+
+        if self.ddpg.donate:
+            # restored carries (orbax checkpoints, caller-held pytrees) may
+            # alias each other or host-owned storage; donation needs
+            # exclusively-owned device buffers — donating a restored state
+            # aborts the process on the CPU backend (pending_donation_
+            # check).  Re-materialize once before the first donated
+            # dispatch, mirroring init()'s target-aliasing break.
+            if init_state is not None:
+                init_state = jax.tree_util.tree_map(jnp.copy, init_state)
+            if init_buffer is not None:
+                init_buffer = jax.tree_util.tree_map(jnp.copy, init_buffer)
+
+        prefetch = None
+        if pipeline:
+            # traffic staged to device FROM THE PREFETCH THREAD, so the
+            # host→device transfer also overlaps the running episode; the
+            # topology object passes through untouched (it is the driver's
+            # cached pytree — id()-keyed caches downstream rely on that)
+            # stop bound covers the unconditional initial sample even when
+            # the episode range is empty (the serial loop's behavior)
+            prefetch = self.driver.prefetcher(
+                start_episode, max(episodes, start_episode + 1), test_mode,
+                stage=lambda topo, traffic: (topo, jax.device_put(traffic)))
+
+        def next_episode(ep):
+            if prefetch is not None:
+                # blocks only when the producer thread is behind — i.e.
+                # host sampling is the true bottleneck, not the sync order
+                with timer.phase("host_sample_wait"):
+                    return prefetch.get(ep)
+            with timer.phase("host_sample"):
+                return self.driver.episode(ep, test_mode)
+
+        pending = []  # dispatched episodes whose metrics are not yet synced
+        # serial path drains immediately (the seed behavior); pipelined
+        # drains lag one episode so the sync never gates the next dispatch
+        max_pending = 1 if pipeline else 0
+        try:
+            topo, traffic = next_episode(start_episode)
+            env_state, obs = self.env.reset(
+                jax.random.fold_in(base, 1000 + start_episode), topo,
+                traffic)
+            state = init_state if init_state is not None else \
+                self.ddpg.init(jax.random.fold_in(base, 0), obs)
+            buffer = init_buffer if init_buffer is not None else \
+                self.ddpg.init_buffer(obs)
+            if verbose:
+                from .buffer import buffer_nbytes
+                log.info(
+                    "replay buffer: %.1f MiB resident%s",
+                    buffer_nbytes(buffer) / 2 ** 20,
+                    " — donated, updated in place each episode"
+                    if self.ddpg.donate else
+                    " — copied each episode (donate=False)")
+
+            start = time.time()
+            for ep in range(start_episode, episodes):
+                if ep > start_episode:
+                    topo, traffic = next_episode(ep)
+                    env_state, obs = self.env.reset(
+                        jax.random.fold_in(base, 1000 + ep), topo, traffic)
+                global_step = ep * steps_per_ep
+                end_step = global_step + steps_per_ep - 1
+                learn = (end_step
+                         >= self.agent_cfg.nb_steps_warmup_critic - 1)
+                with timer.phase("dispatch"):
+                    if pipeline:
+                        (state, buffer, env_state, obs, stats,
+                         learn_metrics) = self.ddpg.episode_step(
+                            state, buffer, env_state, obs, topo, traffic,
+                            np.int32(global_step), learn=learn)
+                    else:
+                        (state, buffer, env_state, obs,
+                         stats) = self.ddpg.rollout_episode(
+                            state, buffer, env_state, obs, topo, traffic,
+                            np.int32(global_step))
+                        learn_metrics = None
+                        if learn:
+                            state, learn_metrics = self.ddpg.learn_burst(
+                                state, buffer)
+                # the retained arrays (stats, learn metrics, the truncation
+                # scalar) are plain kernel outputs — never donated, so
+                # deferring their sync is safe under buffer donation
+                pending.append((ep, end_step, stats, learn_metrics,
+                                env_state.sim.truncated_arrivals))
+                while len(pending) > max_pending:
+                    self._drain(pending.pop(0), start, start_episode,
+                                verbose, timer)
+            while pending:
+                # happy-path tail drain stays INSIDE the try: an async
+                # device fault surfacing at the final episode's sync must
+                # raise like the serial loop would, not be downgraded
+                self._drain(pending.pop(0), start, start_episode, verbose,
+                            timer)
+        finally:
+            # only nonempty when an exception is already propagating:
+            # flush completed episodes' rows into rewards.csv exactly as
+            # the serial loop would have written them before the fault.
+            # Best effort — a drain that itself fails (device in a bad
+            # state) must not mask the original exception.
+            while pending:
+                entry = pending.pop(0)
+                try:
+                    self._drain(entry, start, start_episode, verbose,
+                                timer)
+                except Exception:
+                    log.warning("dropping metrics of episode %d: drain "
+                                "failed after a faulted dispatch", entry[0])
+                    break
+            if prefetch is not None:
+                prefetch.close()
+        if verbose:
+            log.info("pipeline phase timings: %s", timer.summary())
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()
@@ -204,6 +343,13 @@ class Trainer:
                              num_replicas=num_replicas, donate=True,
                              gnn_impl=self.ddpg.actor.gnn_impl)
         base = jax.random.PRNGKey(self.seed)
+        # restored carries must be re-materialized before donation — see
+        # train(): donating orbax-restored (host-owned / aliased) buffers
+        # aborts the process
+        if init_state is not None:
+            init_state = jax.tree_util.tree_map(jnp.copy, init_state)
+        if init_buffers is not None:
+            init_buffers = jax.tree_util.tree_map(jnp.copy, init_buffers)
 
         topo0, traffic0 = self.driver.episode(0, False)
         _, one_obs = self.env.reset(jax.random.fold_in(base, 1000), topo0,

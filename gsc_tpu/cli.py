@@ -193,11 +193,17 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--chunk", default=50, show_default=True,
               help="rollout steps per device call with --replicas > 1 "
                    "(long single-call scans exceed TPU per-call limits)")
+@click.option("--pipeline/--no-pipeline", default=True, show_default=True,
+              help="asynchronous episode pipeline (--replicas 1 path): "
+                   "background traffic prefetch, fused rollout+learn "
+                   "device step, deferred metric draining — bit-identical "
+                   "results, the chip never idles between episodes; "
+                   "--no-pipeline runs the serial reference loop")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          verbose):
+          pipeline, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -286,7 +292,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                                           profile=profile,
                                           init_state=init_state,
                                           init_buffer=init_buffer,
-                                          start_episode=start_episode)
+                                          start_episode=start_episode,
+                                          pipeline=pipeline)
         result.runtime_stop("train")
 
         ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
@@ -376,6 +383,14 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     svc = load_service(service,
                        resource_functions_path=resource_functions_path)
     sim_cfg = load_sim(config)
+    if per_flow_algo != "local" and sim_cfg.controller != "per_flow":
+        # fail BEFORE the expensive setup (GraphML load, traffic
+        # generation, engine init) — the mismatch is knowable right here
+        raise click.BadParameter(
+            f"--per-flow-algo {per_flow_algo} requires 'controller: "
+            "per_flow' in the simulator config (this config runs the "
+            "duration controller, which would silently ignore the "
+            "algorithm)")
     limits = EnvLimits.for_service(svc, max_nodes=max_nodes,
                                    max_edges=max_edges)
     topo = load_topology(network, max_nodes=max_nodes, max_edges=max_edges,
@@ -392,12 +407,6 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     nm = np.asarray(topo.node_mask)
     n_real = int(nm.sum())
     state = engine.init(jax.random.PRNGKey(seed), topo)
-    if per_flow_algo != "local" and sim_cfg.controller != "per_flow":
-        raise click.BadParameter(
-            f"--per-flow-algo {per_flow_algo} requires 'controller: "
-            "per_flow' in the simulator config (this config runs the "
-            "duration controller, which would silently ignore the "
-            "algorithm)")
     if sim_cfg.controller == "per_flow":
         # FlowController granularity (flow_controller.py:21-92): each
         # deciding flow gets an individual destination every substep.

@@ -10,7 +10,9 @@ each episode gets a freshly sampled ``TrafficSchedule``.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -85,3 +87,94 @@ class EpisodeDriver:
                 seed: Optional[int] = None):
         topo = self.topology_for(episode, test_mode)
         return topo, self.traffic_for(episode, topo, seed)
+
+    def prefetcher(self, start: int, stop: int, test_mode: bool = False,
+                   depth: int = 2,
+                   stage: Optional[Callable] = None) -> "EpisodePrefetcher":
+        """Background double buffer over ``episode``: episode k+1's traffic
+        is sampled (and optionally staged to device via ``stage``) while
+        episode k's rollout runs on the accelerator."""
+        return EpisodePrefetcher(self, start, stop, test_mode=test_mode,
+                                 depth=depth, stage=stage)
+
+
+class EpisodePrefetcher:
+    """Host-side episode pipeline: a daemon thread runs the driver's
+    per-episode sampling (topology selection + host traffic generation)
+    ``depth`` episodes ahead of the training loop, through a bounded queue.
+
+    The sequence is IDENTICAL to serial ``driver.episode(ep, test_mode)``
+    calls — traffic is seeded purely by the episode index
+    (``base_seed + episode``), so look-ahead cannot perturb it, and the
+    topology objects are the driver's own cached ``Topology`` pytrees (the
+    same Python objects the serial path yields, preserving ``id(topo)``
+    keyed caches downstream).
+
+    ``stage(topo, traffic) -> (topo, traffic)`` runs IN the producer thread
+    — pass a ``jax.device_put`` wrapper to overlap the host→device transfer
+    with the running episode as well (transfers are thread-safe and async).
+    """
+
+    _DONE = "done"
+    _ERROR = "error"
+
+    def __init__(self, driver: EpisodeDriver, start: int, stop: int,
+                 test_mode: bool = False, depth: int = 2,
+                 stage: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.driver = driver
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop_flag = threading.Event()
+        self._args = (start, stop, test_mode, stage)
+        self._thread = threading.Thread(
+            target=self._produce, name="gsc-episode-prefetch", daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        start, stop, test_mode, stage = self._args
+        try:
+            for ep in range(start, stop):
+                item = self.driver.episode(ep, test_mode)
+                if stage is not None:
+                    item = stage(*item)
+                # bounded put, polled so close() can abandon a full queue
+                while not self._stop_flag.is_set():
+                    try:
+                        self._queue.put((ep, item), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop_flag.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer's next get()
+            self._queue.put((self._ERROR, e))
+        else:
+            self._queue.put((self._DONE, None))
+
+    def get(self, episode: int):
+        """(topo, traffic) for ``episode`` — episodes must be consumed in
+        the order the prefetcher was built for."""
+        tag, item = self._queue.get()
+        if tag == self._ERROR:
+            raise RuntimeError(
+                "episode prefetch thread failed") from item
+        if tag == self._DONE:
+            raise RuntimeError(
+                f"prefetcher exhausted before episode {episode}")
+        if tag != episode:
+            raise RuntimeError(
+                f"out-of-order prefetch consumption: asked for episode "
+                f"{episode}, next staged is {tag}")
+        return item
+
+    def close(self):
+        """Stop the producer; safe to call at any point (including after an
+        exception mid-epoch)."""
+        self._stop_flag.set()
+        try:
+            while True:  # unblock a producer waiting on a full queue
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..agents.buffer import (ReplayBuffer, buffer_add, flatten_transition,
                              restore_batch, transition_shapes)
-from ..agents.ddpg import DDPG, DDPGState
+from ..agents.ddpg import DDPG, DDPGState, donated_jit
 from ..config.schema import AgentConfig
 from ..env.actions import action_mask
 from ..env.env import ServiceCoordEnv
@@ -40,20 +40,30 @@ class ParallelDDPG:
         self.agent = agent
         self.B = num_replicas
         self.sample_mode = sample_mode
-        self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl)
+        # the inner DDPG inherits ``donate`` so init() breaks the
+        # target-params/params buffer aliasing that donation of the learner
+        # state would otherwise trip over (double donation)
+        self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl, donate=donate)
         # ``donate=True`` aliases the replay shards into the rollout call,
         # so XLA appends transitions to the multi-GB replay in place
-        # instead of copying it every chunk call.  Only the buffers are
-        # donated: other carried pytrees legitimately share device buffers
-        # (target params alias params at init; obs leaves can alias env
-        # state), which XLA rejects as double donation.  Callers must
-        # treat the passed-in buffers as CONSUMED (always rebind from the
+        # instead of copying it every chunk call, and the learner state
+        # into the learn burst / fused chunk step.  ``obs`` and env states
+        # are never donated here: their leaves can legitimately share
+        # device buffers, which XLA rejects as double donation.  Callers
+        # must treat donated arguments as CONSUMED (always rebind from the
         # return) — the training loops do; comparison-style double-calls
         # on the same inputs must keep the default.
         if donate:
-            self.rollout_episodes = partial(
-                jax.jit(type(self).rollout_episodes.__wrapped__,
-                        static_argnums=(0, 8), donate_argnums=(2,)), self)
+            cls = type(self)
+            self.rollout_episodes = donated_jit(
+                self, cls.rollout_episodes, static_argnums=(0, 8),
+                donate_argnums=(2,))
+            self.learn_burst = donated_jit(
+                self, cls.learn_burst, static_argnums=(0,),
+                donate_argnums=(1,))
+            self.chunk_step = donated_jit(
+                self, cls.chunk_step, static_argnums=(0, 8, 9),
+                donate_argnums=(1, 2))
         # With per_replica_topology, ``topo`` arguments carry a leading [B]
         # axis (build with topology.stack_topologies) and every replica
         # trains on its own network — topology-generalization pressure in
@@ -99,26 +109,13 @@ class ParallelDDPG:
             keys, topo, traffic)
 
     # -------------------------------------------------------------- rollout
-    @partial(jax.jit, static_argnums=(0, 8))
-    def rollout_episodes(self, state: DDPGState, buffers: ReplayBuffer,
-                         env_states, obs, topo, traffic,
-                         episode_start_step, num_steps: int = None) -> Tuple[
-                             DDPGState, ReplayBuffer, Any, Any,
-                             Dict[str, jnp.ndarray]]:
-        """One episode on every replica: scan over steps of a vmapped
-        (action -> env.step -> buffer.add) body.  Parameters are shared
-        (replicated); env state, obs, buffers and traffic carry the leading
-        [B] replica axis.
-
-        ``num_steps`` (static) overrides the scan length so an episode can be
-        split into several shorter device calls (carry env_states/obs/buffers
-        across calls, pass the global step of the chunk start as
-        ``episode_start_step``).  Long single-call scans (200 steps x 100
-        engine substeps) exceed the TPU runtime's per-call limits; 25-50-step
-        chunks are the validated operating range.  Chunked resumption assumes
-        ``shuffle_nodes`` is off (default): with shuffling on, each call
-        opens a fresh permutation frame, which is only correct at episode
-        boundaries."""
+    def _rollout_body(self, state: DDPGState, buffers: ReplayBuffer,
+                      env_states, obs, topo, traffic,
+                      episode_start_step, num_steps: int = None) -> Tuple[
+                          DDPGState, ReplayBuffer, Any, Any,
+                          Dict[str, jnp.ndarray]]:
+        """Replica rollout scan shared by ``rollout_episodes`` and the
+        fused ``chunk_step`` (traced inside their jits)."""
         from ..env.permutation import ShuffleOps
         if (self.agent.shuffle_nodes and num_steps is not None
                 and num_steps % self.agent.episode_steps != 0):
@@ -174,6 +171,56 @@ class ParallelDDPG:
         }
         return (state.replace(rng=rng), buffers, env_states, obs,
                 episode_stats)
+
+    @partial(jax.jit, static_argnums=(0, 8))
+    def rollout_episodes(self, state: DDPGState, buffers: ReplayBuffer,
+                         env_states, obs, topo, traffic,
+                         episode_start_step, num_steps: int = None) -> Tuple[
+                             DDPGState, ReplayBuffer, Any, Any,
+                             Dict[str, jnp.ndarray]]:
+        """One episode on every replica: scan over steps of a vmapped
+        (action -> env.step -> buffer.add) body.  Parameters are shared
+        (replicated); env state, obs, buffers and traffic carry the leading
+        [B] replica axis.
+
+        ``num_steps`` (static) overrides the scan length so an episode can be
+        split into several shorter device calls (carry env_states/obs/buffers
+        across calls, pass the global step of the chunk start as
+        ``episode_start_step``).  Long single-call scans (200 steps x 100
+        engine substeps) exceed the TPU runtime's per-call limits; 25-50-step
+        chunks are the validated operating range.  Chunked resumption assumes
+        ``shuffle_nodes`` is off (default): with shuffling on, each call
+        opens a fresh permutation frame, which is only correct at episode
+        boundaries."""
+        return self._rollout_body(state, buffers, env_states, obs, topo,
+                                  traffic, episode_start_step, num_steps)
+
+    @partial(jax.jit, static_argnums=(0, 8, 9))
+    def chunk_step(self, state: DDPGState, buffers: ReplayBuffer,
+                   env_states, obs, topo, traffic, episode_start_step,
+                   num_steps: int = None, learn: bool = False) -> Tuple[
+                       DDPGState, ReplayBuffer, Any, Any,
+                       Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Fused chunk rollout + (optional) learn burst in ONE device
+        program — the replica-parallel analogue of ``DDPG.episode_step``.
+        Drive an episode as ``episode_steps/chunk`` calls with
+        ``learn=False`` and pass ``learn=True`` on the FINAL chunk: the
+        end-of-episode learn burst then runs in the same program as the
+        last rollout chunk, eliminating the host round-trip between them
+        and letting XLA overlap the scan tail with the first gradient
+        steps.  The op sequence is identical to ``rollout_episodes`` +
+        ``learn_burst``, so results are bit-identical to the two-call
+        path.  Returns ``learn_metrics=None`` when ``learn=False``."""
+        state, buffers, env_states, obs, stats = self._rollout_body(
+            state, buffers, env_states, obs, topo, traffic,
+            episode_start_step, num_steps)
+        metrics = None
+        if learn:
+            sampler = (self._sample_local if self.sample_mode == "local"
+                       else self._sample_across)
+            state, metrics = self.ddpg._learn_burst(
+                state, lambda k: sampler(buffers, k))
+        return state, buffers, env_states, obs, stats, metrics
 
     # ------------------------------------------------------------- learning
     def _sample_across(self, buffers: ReplayBuffer, key):

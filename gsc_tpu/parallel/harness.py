@@ -1,9 +1,10 @@
 """Chunked-episode measurement harness shared by the throughput/quality
 tools (tools/learning_curve.py, tools/quality_sweep.py).
 
-Episodes execute as several shorter ``rollout_episodes`` device calls
-(the TPU operating mode — see ParallelDDPG.rollout_episodes) with the
-end-of-episode learn burst, and per-episode metrics are aggregated over
+Episodes execute as several shorter fused ``chunk_step`` device calls
+(the TPU operating mode — see ParallelDDPG.rollout_episodes for the
+chunking contract), the LAST one carrying the end-of-episode learn burst
+in the same device program, and per-episode metrics are aggregated over
 ALL chunks: ``episodic_return`` sums across chunks and the success ratio
 averages them — a single chunk's stats cover only that chunk's steps, so
 reading the last chunk would score episodes on an end-of-episode slice.
@@ -47,14 +48,20 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
             jax.random.fold_in(jax.random.PRNGKey(seed + 2), ep),
             topo, traffic)
         chunk_stats = []
-        for c in range(episode_steps // chunk):
+        n_chunks = episode_steps // chunk
+        for c in range(n_chunks):
             start = jnp.int32(step_offset + ep * episode_steps + c * chunk)
-            state, buffers, env_states, obs, stats = pddpg.rollout_episodes(
-                state, buffers, env_states, obs, topo, traffic, start, chunk)
+            # the FINAL chunk fuses the end-of-episode learn burst into the
+            # same device program (ParallelDDPG.chunk_step) — no host
+            # round-trip between the last rollout call and the learner;
+            # results are bit-identical to the two-call path
+            state, buffers, env_states, obs, stats, metrics = \
+                pddpg.chunk_step(state, buffers, env_states, obs, topo,
+                                 traffic, start, chunk,
+                                 learn=(c == n_chunks - 1))
             chunk_stats.append(stats)   # device scalars: convert AFTER the
             # episode is dispatched — a float() here would sync the host
             # every chunk and depress the measured wall rate
-        state, metrics = pddpg.learn_burst(state, buffers)
         returns.append(sum(float(s["episodic_return"])
                            for s in chunk_stats))
         succ.append(sum(float(s["mean_succ_ratio"]) for s in chunk_stats)

@@ -11,11 +11,53 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Optional, Sequence
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 from ..config.schema import DROP_REASONS
+
+
+class PhaseTimer:
+    """Per-phase host wall timing for the asynchronous episode pipeline.
+
+    The pipeline's win is OVERLAP — host traffic sampling and metric
+    draining hidden behind device compute — which a single SPS number
+    cannot attribute.  This accumulates host-side wall time per named phase
+    (``host_sample``, ``dispatch``, ``drain``, ...): ``dispatch`` is the
+    time the loop spends handing work to the device (async, so near-zero
+    unless the dispatch queue is full — i.e. the device is the
+    bottleneck), ``drain`` is time blocked on device→host metric syncs,
+    and ``host_sample`` only appears on the serial path (the prefetch
+    thread absorbs it on the pipelined path).  A pipelined run should show
+    drain+host_sample collapsing toward zero while dispatch grows to cover
+    the device wall."""
+
+    def __init__(self):
+        self._total: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float):
+        self._total[name] = self._total.get(name, 0.0) + seconds
+        self._count[name] = self._count.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """{phase: {total_s, count, mean_ms}} over everything recorded."""
+        return {
+            name: {"total_s": round(t, 4), "count": self._count[name],
+                   "mean_ms": round(1e3 * t / max(self._count[name], 1), 3)}
+            for name, t in sorted(self._total.items())
+        }
 
 
 class TestModeWriter:

@@ -209,25 +209,27 @@ def test_harness_global_step_offsets():
     class Spy:
         def __init__(self):
             self.starts = []
+            self.learns = []
 
         def reset_all(self, rng, topo, traffic):
             return None, None
 
-        def rollout_episodes(self, state, buffers, es, obs, topo, traffic,
-                             start, chunk):
+        def chunk_step(self, state, buffers, es, obs, topo, traffic,
+                       start, chunk, learn=False):
             self.starts.append(int(start))
+            self.learns.append(learn)
             stats = {"episodic_return": jnp.float32(1.0),
                      "mean_succ_ratio": jnp.float32(0.5),
                      "final_succ_ratio": jnp.float32(0.5)}
-            return state, buffers, es, obs, stats
-
-        def learn_burst(self, state, buffers):
-            return state, {"critic_loss": jnp.float32(0.0)}
+            metrics = {"critic_loss": jnp.float32(0.0)} if learn else None
+            return state, buffers, es, obs, stats, metrics
 
     spy = Spy()
     run_chunked_episodes(spy, None, lambda ep: None, None, None,
                          episodes=2, episode_steps=4, chunk=2, seed=0)
     assert spy.starts == [0, 2, 4, 6]
+    # the learn burst fuses into the LAST chunk of each episode only
+    assert spy.learns == [False, True, False, True]
     spy.starts.clear()
     run_chunked_episodes(spy, None, lambda ep: None, None, None,
                          episodes=1, episode_steps=4, chunk=2, seed=0,

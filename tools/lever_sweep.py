@@ -11,16 +11,26 @@ that the r4 profile work identified but never measured on chip:
 
 Each cell times ``--calls`` chunked rollout calls (compile + 1 warm call
 excluded) and prints a JSON row; the last line is the winner.  Run it in
-a dedicated chip window (single process — never concurrent with bench):
+a dedicated chip window (single process group — never concurrent with
+bench):
 
     python tools/lever_sweep.py                       # default grid
     python tools/lever_sweep.py --cpu --grid smoke    # CPU smoke
+
+Every cell runs as a BOUNDED SUBPROCESS (bench.py's orchestrator model):
+a cell that wedges the TPU backend hangs alone and is killed at
+``--cell-timeout``, instead of silently burning the whole chip-window
+stage timeout and dropping the cells after it; after any unclean cell the
+backend is re-probed (bench.probe) before the next one is trusted to the
+chip.  ``--in-process`` restores the single-process mode (CI/CPU smoke).
 """
 from __future__ import annotations
 
 import argparse
 import itertools
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -87,40 +97,102 @@ def measure(B, chunk, max_flows, unroll, calls, episode_steps):
             "compile_s": round(compile_s, 1)}
 
 
+def _cell_in_process(cell, args):
+    """Measure one grid cell in THIS process (the subprocess entry, and
+    the --in-process fallback)."""
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    try:  # same persistent compile cache bench.py uses
+        from bench import _enable_compile_cache
+        _enable_compile_cache()
+    except Exception:
+        pass
+    B, chunk, mf, unroll = cell
+    try:
+        row = measure(B, chunk, mf, unroll, args.calls, args.episode_steps)
+    except Exception as e:  # one faulted cell must not kill the sweep
+        row = {"replicas": B, "chunk": chunk, "max_flows": mf,
+               "scan_unroll": unroll, "error": repr(e)[:200]}
+    jax.clear_caches()  # cap live executables/HBM across cells
+    return row
+
+
+def _cell_subprocess(cell, args):
+    """Run one grid cell as a bounded child: a wedged-backend hang is
+    killed at --cell-timeout instead of eating the stage budget, and the
+    parent process never touches the chip (so it cannot be wedged)."""
+    B, chunk, mf, unroll = cell
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--cell", f"{B},{chunk},{mf},{unroll}",
+           "--calls", str(args.calls),
+           "--episode-steps", str(args.episode_steps)]
+    if args.cpu:
+        cmd.append("--cpu")
+    tag = {"replicas": B, "chunk": chunk, "max_flows": mf,
+           "scan_unroll": unroll}
+    try:
+        r = subprocess.run(cmd, timeout=args.cell_timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {**tag, "error": f"cell timeout ({args.cell_timeout}s) — "
+                "backend hang killed"}, False
+    sys.stderr.write((r.stderr or "")[-1000:])
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "env_steps_per_sec" in row or "error" in row:
+            return row, r.returncode == 0 and "error" not in row
+    return {**tag, "error": f"cell produced no row (rc={r.returncode})"}, \
+        False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--grid", choices=sorted(GRIDS), default="default")
     ap.add_argument("--calls", type=int, default=3)
     ap.add_argument("--episode-steps", type=int, default=200)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--cell-timeout", type=int, default=900,
+                    help="hard wall per grid cell (subprocess kill)")
+    ap.add_argument("--in-process", action="store_true",
+                    help="run cells in this process (no per-cell bound) — "
+                         "CI/CPU smoke mode")
+    ap.add_argument("--cell", default=None,
+                    help="internal: measure one 'B,chunk,mf,unroll' cell "
+                         "and print its row")
     args = ap.parse_args()
 
-    import jax
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-    try:  # same persistent compile cache bench.py uses
-        sys.path.insert(0, __file__.rsplit("/", 2)[0])
-        from bench import _enable_compile_cache
-        _enable_compile_cache()
-    except Exception:
-        pass
+    if args.cell:
+        cell = tuple(int(x) for x in args.cell.split(","))
+        print(json.dumps(_cell_in_process(cell, args)), flush=True)
+        return
 
+    from bench import probe  # bounded-time backend health check
     rows = []
-    for B, chunk, mf, unroll in GRIDS[args.grid]:
-        try:
-            row = measure(B, chunk, mf, unroll, args.calls,
-                          args.episode_steps)
-        except Exception as e:  # one faulted cell must not kill the sweep
-            row = {"replicas": B, "chunk": chunk, "max_flows": mf,
-                   "scan_unroll": unroll, "error": repr(e)[:200]}
+    for cell in GRIDS[args.grid]:
+        if args.in_process:
+            row, clean = _cell_in_process(cell, args), True
+        else:
+            row, clean = _cell_subprocess(cell, args)
         rows.append(row)
         print(json.dumps(row), flush=True)
-        jax.clear_caches()  # cap live executables/HBM across cells
+        if not clean and not args.cpu:
+            # tpu_validate's probe-skip protocol: an unclean cell may have
+            # wedged the chip — only continue if the backend still answers
+            # a bounded probe, otherwise the remaining cells would hang
+            # one after another
+            if not probe():
+                print(json.dumps({"error": "backend unhealthy after "
+                                  "failed cell — stopping sweep",
+                                  "cells_run": len(rows)}), flush=True)
+                break
     ok = [r for r in rows if "env_steps_per_sec" in r]
     if ok:
         best = max(ok, key=lambda r: r["env_steps_per_sec"])
-        print(json.dumps({"winner": best,
-                          "backend": jax.default_backend()}))
+        print(json.dumps({"winner": best}))
 
 
 if __name__ == "__main__":
