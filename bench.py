@@ -402,6 +402,7 @@ def worker(replicas: int, chunk: int, episodes: int,
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
 
+    from gsc_tpu.obs.device import device_memory_snapshot
     from gsc_tpu.utils.telemetry import PhaseTimer
     timer = PhaseTimer()
 
@@ -438,6 +439,13 @@ def worker(replicas: int, chunk: int, episodes: int,
             jax.block_until_ready(out[4:])
         dt = time.time() - t0
         sps = ep * EPISODE_STEPS * B / dt
+        # obs-subsystem columns, same sources as a train run's
+        # events.jsonl: per-phase host wall so a slow row is attributable
+        # (dispatch-bound vs drain-bound), and HBM readings so
+        # replay/working-set growth across rungs is visible in the banked
+        # artifacts (empty list on backends without memory_stats, e.g.
+        # CPU dry runs)
+        mem = device_memory_snapshot()
         print(json.dumps({
             "metric": "env_steps_per_sec_per_chip",
             "value": round(sps, 1),
@@ -446,6 +454,8 @@ def worker(replicas: int, chunk: int, episodes: int,
             "pipeline": pipeline,
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
+            "phases": timer.summary(),
+            "device_mem": [m for m in mem if m.get("available")],
             **({"knobs": knobs} if knobs else {}),
         }), flush=True)
 
@@ -467,7 +477,6 @@ def worker(replicas: int, chunk: int, episodes: int,
             if pipeline:
                 if prev is not None:
                     bank(*prev, t0)
-                    prev = None
                 prev = (ep, out)
             else:
                 bank(ep, out, t0)

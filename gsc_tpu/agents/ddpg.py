@@ -295,8 +295,13 @@ class DDPG:
             target_critic_params=polyak(state.target_critic_params,
                                         critic_params),
             actor_opt=actor_opt, critic_opt=critic_opt, rng=state.rng)
+        # grad norms ride along for run telemetry (events.jsonl) — computed
+        # from the already-materialized grads, so the update path is
+        # untouched and pipeline/serial bit-identity holds
         metrics = {"critic_loss": critic_loss, "actor_loss": actor_loss,
-                   "q_values": q_vals.mean()}
+                   "q_values": q_vals.mean(),
+                   "critic_grad_norm": optax.global_norm(cgrad),
+                   "actor_grad_norm": optax.global_norm(agrad)}
         return state, metrics
 
     def _learn_burst(self, state: DDPGState, sample_fn
@@ -315,7 +320,9 @@ class DDPG:
             return st, metrics
 
         zero = {"critic_loss": jnp.zeros(()), "actor_loss": jnp.zeros(()),
-                "q_values": jnp.zeros(())}
+                "q_values": jnp.zeros(()),
+                "critic_grad_norm": jnp.zeros(()),
+                "actor_grad_norm": jnp.zeros(())}
         n_steps = (self.agent.learn_steps if self.agent.learn_steps
                    is not None else self.agent.episode_steps)
         state, metrics = jax.lax.fori_loop(0, n_steps, body, (state, zero))

@@ -65,11 +65,21 @@ class Trainer:
                  agent_cfg: AgentConfig, seed: int = 0,
                  result_dir: Optional[str] = None,
                  tensorboard: bool = False, gnn_impl: str = None,
-                 donate: bool = True):
+                 donate: bool = True, obs=None,
+                 check_invariants: bool = False):
         self.env = env
         self.driver = driver
         self.agent_cfg = agent_cfg
         self.seed = seed
+        # run observability (gsc_tpu.obs.RunObserver): events.jsonl +
+        # metrics.json + device gauges + pipeline watchdog.  The trainer
+        # only reports into it; lifecycle (start/close) belongs to the
+        # caller (cli train wraps the whole run).
+        self.obs = obs
+        # opt-in per-episode simulator invariant check (utils.debug) —
+        # violations surface as structured ``invariant_violation`` events
+        # (and WARNs) instead of a silently-returned list
+        self.check_invariants = check_invariants
         # donation is on by default: the training loops always rebind the
         # carries from the kernel returns, so in-place HBM updates of the
         # replay/env-state are safe; pass donate=False for comparison
@@ -119,8 +129,11 @@ class Trainer:
         head, so the ``np.asarray`` syncs here wait on device work that has
         already been followed by the next episode's dispatch — the chip
         never idles on host-side logging."""
-        ep, end_step, stats, learn_metrics, trunc_dev = entry
-        with timer.phase("drain"):
+        from ..obs.trace import phase_span
+        ep, end_step, stats, learn_metrics, trunc_dev, sim, topo, \
+            replay_bytes = entry
+        hub = self.obs.hub if self.obs else None
+        with phase_span("drain", timer, hub):
             # force the episode's device work complete BEFORE reading the
             # wall clock: sps must divide by time that includes the
             # episode's compute (bench.py's bank() contract), not the
@@ -147,6 +160,34 @@ class Trainer:
                     "episode=%d return=%.3f succ=%.3f sps=%.1f", ep,
                     float(np.asarray(stats["episodic_return"])),
                     float(np.asarray(stats["mean_succ_ratio"])), sps)
+        # observability work sits OUTSIDE the drain span: the drain phase
+        # measures time blocked on device→host metric syncs, not host-side
+        # bookkeeping — and the emitted event then carries phase totals
+        # that include the drain just finished
+        if self.check_invariants:
+            # promoted from utils.debug: per drained episode, the final
+            # sim state is checked host-side and violations become
+            # structured events rather than a silently-returned list
+            from ..utils.debug import check_invariants
+            errs = check_invariants(sim, topo, self.env.tables.chain_len)
+            if errs:
+                log.warning("episode=%d simulator invariants violated: %s",
+                            ep, "; ".join(errs))
+                if self.obs:
+                    self.obs.hub.event("invariant_violation", episode=ep,
+                                       violations=errs)
+        if self.obs:
+            from ..config.schema import DROP_REASONS
+            row = self.history[-1]
+            self.obs.episode_end(
+                episode=ep, global_step=end_step,
+                metrics={k: v for k, v in row.items()
+                         if k not in ("episode", "sps")},
+                sps=sps, phases=timer.summary(),
+                drop_reasons=dict(zip(
+                    DROP_REASONS,
+                    np.asarray(sim.metrics.drop_reasons).tolist())),
+                truncated_arrivals=trunc, replay_bytes=replay_bytes)
 
     def train(self, episodes: int, test_mode: bool = False,
               verbose: bool = False, profile: bool = False,
@@ -181,8 +222,11 @@ class Trainer:
                                   init_buffer=init_buffer,
                                   start_episode=start_episode,
                                   pipeline=pipeline)
+        from ..obs.trace import episode_span, phase_span
         from ..utils.telemetry import PhaseTimer
+        from .buffer import buffer_nbytes
         self.phase_timer = timer = PhaseTimer()
+        hub = self.obs.hub if self.obs else None
         base = jax.random.PRNGKey(self.seed)
         steps_per_ep = self.agent_cfg.episode_steps
 
@@ -208,15 +252,23 @@ class Trainer:
             # the episode range is empty (the serial loop's behavior)
             prefetch = self.driver.prefetcher(
                 start_episode, max(episodes, start_episode + 1), test_mode,
-                stage=lambda topo, traffic: (topo, jax.device_put(traffic)))
+                stage=lambda topo, traffic: (topo, jax.device_put(traffic)),
+                heartbeat=(self.obs.prefetcher_heartbeat()
+                           if self.obs else None))
+            if self.obs:
+                self.obs.attach_prefetcher(prefetch)
+        if self.obs:
+            # arm the stall monitor only while the episode loop runs —
+            # compile/eval/checkpoint time is not a pipeline stall
+            self.obs.resume_watchdog()
 
         def next_episode(ep):
             if prefetch is not None:
                 # blocks only when the producer thread is behind — i.e.
                 # host sampling is the true bottleneck, not the sync order
-                with timer.phase("host_sample_wait"):
+                with phase_span("host_sample_wait", timer, hub):
                     return prefetch.get(ep)
-            with timer.phase("host_sample"):
+            with phase_span("host_sample", timer, hub):
                 return self.driver.episode(ep, test_mode)
 
         pending = []  # dispatched episodes whose metrics are not yet synced
@@ -232,11 +284,13 @@ class Trainer:
                 self.ddpg.init(jax.random.fold_in(base, 0), obs)
             buffer = init_buffer if init_buffer is not None else \
                 self.ddpg.init_buffer(obs)
+            # replay residency is static across the run (ring buffer):
+            # computed once from shapes, streamed in every episode event
+            replay_bytes = buffer_nbytes(buffer)
             if verbose:
-                from .buffer import buffer_nbytes
                 log.info(
                     "replay buffer: %.1f MiB resident%s",
-                    buffer_nbytes(buffer) / 2 ** 20,
+                    replay_bytes / 2 ** 20,
                     " — donated, updated in place each episode"
                     if self.ddpg.donate else
                     " — copied each episode (donate=False)")
@@ -251,7 +305,7 @@ class Trainer:
                 end_step = global_step + steps_per_ep - 1
                 learn = (end_step
                          >= self.agent_cfg.nb_steps_warmup_critic - 1)
-                with timer.phase("dispatch"):
+                with phase_span("dispatch", timer, hub), episode_span(ep):
                     if pipeline:
                         (state, buffer, env_state, obs, stats,
                          learn_metrics) = self.ddpg.episode_step(
@@ -266,11 +320,17 @@ class Trainer:
                         if learn:
                             state, learn_metrics = self.ddpg.learn_burst(
                                 state, buffer)
+                if self.obs:
+                    self.obs.episode_dispatched(ep)
                 # the retained arrays (stats, learn metrics, the truncation
-                # scalar) are plain kernel outputs — never donated, so
-                # deferring their sync is safe under buffer donation
+                # scalar, and the episode-final sim state the obs/invariant
+                # layer reads) are plain kernel outputs — never donated
+                # (the NEXT episode's env_state comes from a fresh
+                # env.reset, not this one), so deferring their sync is
+                # safe under buffer donation
                 pending.append((ep, end_step, stats, learn_metrics,
-                                env_state.sim.truncated_arrivals))
+                                env_state.sim.truncated_arrivals,
+                                env_state.sim, topo, replay_bytes))
                 while len(pending) > max_pending:
                     self._drain(pending.pop(0), start, start_episode,
                                 verbose, timer)
@@ -281,6 +341,10 @@ class Trainer:
                 self._drain(pending.pop(0), start, start_episode, verbose,
                             timer)
         finally:
+            if self.obs:
+                # disarm BEFORE the best-effort teardown drains — a fault
+                # recovery path must not also spray stall events
+                self.obs.pause_watchdog()
             # only nonempty when an exception is already propagating:
             # flush completed episodes' rows into rewards.csv exactly as
             # the serial loop would have written them before the fault.
@@ -380,31 +444,54 @@ class Trainer:
             return samplers[id(topo)].sample_batch(
                 jax.random.fold_in(base, 2000 + ep), num_replicas)
 
+        from ..utils.telemetry import PhaseTimer
+        from .buffer import buffer_nbytes
+        self.phase_timer = timer = PhaseTimer()
+        hub = self.obs.hub if self.obs else None
+        if self.obs:
+            self.obs.resume_watchdog()
         start = time.time()
-        # the scheduler may swap topologies mid-run, so drive the harness
-        # one episode at a time with that episode's topology — passing the
-        # GLOBAL step offset so the agent's warmup schedule sees one
-        # continuous run (and a resumed run continues it exactly)
-        for ep in range(start_episode, episodes):
-            topo = self.driver.topology_for(ep)
-            traffic = episode_traffic(ep, topo)
-            state, buffers, rets, succ, final = run_chunked_episodes(
-                pddpg, topo, lambda _: traffic, state, buffers,
-                1, steps_per_ep, chunk, self.seed + ep,
-                step_offset=ep * steps_per_ep)
-            sps = ((ep - start_episode + 1) * steps_per_ep * num_replicas
-                   / (time.time() - start))
-            row = {"episodic_return": rets[0], "mean_succ_ratio": succ[0],
-                   "final_succ_ratio": final[0], "episode": ep, "sps": sps}
-            self.history.append(row)
-            self.rewards_writer.write(rets[0])
-            if self.tb:
-                gs = (ep + 1) * steps_per_ep
-                self.tb.add_scalar("charts/episodic_return", rets[0], gs)
-                self.tb.add_scalar("charts/SPS", sps, gs)
-            if verbose:
-                log.info("episode=%d return=%.3f succ=%.3f sps=%.1f",
-                         ep, rets[0], succ[0], sps)
+        try:
+            # the scheduler may swap topologies mid-run, so drive the
+            # harness one episode at a time with that episode's topology —
+            # passing the GLOBAL step offset so the agent's warmup schedule
+            # sees one continuous run (and a resumed run continues it
+            # exactly)
+            for ep in range(start_episode, episodes):
+                topo = self.driver.topology_for(ep)
+                traffic = episode_traffic(ep, topo)
+                if self.obs:
+                    self.obs.episode_dispatched(ep)
+                state, buffers, rets, succ, final = run_chunked_episodes(
+                    pddpg, topo, lambda _: traffic, state, buffers,
+                    1, steps_per_ep, chunk, self.seed + ep,
+                    step_offset=ep * steps_per_ep, hub=hub, timer=timer)
+                sps = ((ep - start_episode + 1) * steps_per_ep
+                       * num_replicas / (time.time() - start))
+                row = {"episodic_return": rets[0],
+                       "mean_succ_ratio": succ[0],
+                       "final_succ_ratio": final[0], "episode": ep,
+                       "sps": sps}
+                self.history.append(row)
+                self.rewards_writer.write(rets[0])
+                if self.tb:
+                    gs = (ep + 1) * steps_per_ep
+                    self.tb.add_scalar("charts/episodic_return", rets[0], gs)
+                    self.tb.add_scalar("charts/SPS", sps, gs)
+                if verbose:
+                    log.info("episode=%d return=%.3f succ=%.3f sps=%.1f",
+                             ep, rets[0], succ[0], sps)
+                if self.obs:
+                    self.obs.episode_end(
+                        episode=ep, global_step=(ep + 1) * steps_per_ep - 1,
+                        metrics={k: v for k, v in row.items()
+                                 if k not in ("episode", "sps")},
+                        sps=sps, phases=timer.summary(),
+                        replay_bytes=buffer_nbytes(buffers),
+                        extra={"replicas": num_replicas})
+        finally:
+            if self.obs:
+                self.obs.pause_watchdog()
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()
@@ -412,11 +499,14 @@ class Trainer:
 
     def evaluate(self, state: DDPGState, episodes: int = 1,
                  test_mode: bool = True, telemetry: bool = False,
-                 write_schedule: bool = False) -> Dict[str, float]:
+                 write_schedule: bool = False,
+                 telemetry_flush_every: int = 1) -> Dict[str, float]:
         """Greedy rollout on the inference network (inference.py:17-40
         semantics: actor only, no noise, no learning).  With ``telemetry``
         the reference's test-mode CSV suite is written to
-        <result_dir>/test (writer.py:16-110 schema)."""
+        <result_dir>/test (writer.py:16-110 schema);
+        ``telemetry_flush_every`` batches the suite's per-interval file
+        flushes for long sweeps (default 1 = reference behavior)."""
         writer = None
         if telemetry and self.result_dir:
             from ..utils.telemetry import TestModeWriter
@@ -424,10 +514,12 @@ class Trainer:
                 os.path.join(self.result_dir, "test"),
                 write_schedule=write_schedule,
                 sf_names=self.env.service.sf_names,
-                sfc_names=self.env.service.sfc_names)
+                sfc_names=self.env.service.sfc_names,
+                flush_every=telemetry_flush_every)
         totals = []
         succ = []
         for ep in range(episodes):
+            t_ep = time.time()
             topo, traffic = self.driver.episode(ep, test_mode)
             rng = jax.random.PRNGKey(self.seed + 10_000 + ep)
             env_state, obs = self.env.reset(rng, topo, traffic)
@@ -465,6 +557,12 @@ class Trainer:
                             env_state.sim.truncated_arrivals)))
             totals.append(ep_reward)
             succ.append(float(np.asarray(infos["succ_ratio"])))
+            if self.obs:
+                # greedy test rollouts stream through the same hub — a
+                # long eval sweep is visible (and device memory sampled)
+                # just like training episodes
+                self.obs.eval_episode(ep, ep_reward, succ[-1],
+                                      time.time() - t_ep)
         if writer:
             writer.close()
         return {"mean_return": float(np.mean(totals)),

@@ -199,11 +199,34 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "device step, deferred metric draining — bit-identical "
                    "results, the chip never idles between episodes; "
                    "--no-pipeline runs the serial reference loop")
+@click.option("--obs/--no-obs", "obs_enabled", default=True,
+              show_default=True,
+              help="unified run telemetry: per-episode events.jsonl "
+                   "(SPS, phase timings, losses/grad-norms, drop reasons, "
+                   "device memory), atomic metrics.json snapshots, and "
+                   "the pipeline watchdog — tools/obs_report.py renders "
+                   "the stream")
+@click.option("--obs-dir", default=None,
+              help="directory for events.jsonl/metrics.json "
+                   "(default: the run's result dir)")
+@click.option("--obs-interval", default=10, show_default=True,
+              help="episodes between atomic metrics.json snapshot "
+                   "rewrites")
+@click.option("--watchdog-budget", default=300.0, show_default=True,
+              help="seconds without a completed episode before the "
+                   "pipeline watchdog emits a structured 'stall' event "
+                   "(0 disables the watchdog)")
+@click.option("--check-invariants/--no-check-invariants", default=False,
+              show_default=True,
+              help="run utils.debug.check_invariants on every drained "
+                   "episode's final simulator state; violations emit "
+                   "structured 'invariant_violation' events")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          pipeline, verbose):
+          pipeline, obs_enabled, obs_dir, obs_interval, watchdog_budget,
+          check_invariants, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -241,68 +264,105 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         env, driver, agent = _build(agent_config, simulator_config, service,
                                     scheduler, run_seed, max_nodes, max_edges,
                                     resource_functions_path)
-        trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
-                          tensorboard=tensorboard)
-        init_state = init_buffer = None
-        start_episode = 0
-        if resume:
-            from .utils.checkpoint import load_full_or_partial
-            topo0, traffic0 = driver.episode(0, False)
-            _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
-            example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
-            if replicas > 1:
-                # replica-sharded replay: [B, capacity, ...] leaves — a
-                # checkpoint from a matching --replicas run restores
-                # fully; anything else falls back to state-only
-                from .parallel import ParallelDDPG
-                example_buffer = ParallelDDPG(
-                    env, agent, num_replicas=replicas).init_buffers(obs0)
-            else:
-                example_buffer = trainer.ddpg.init_buffer(obs0)
-            restored, buffer_ok = load_full_or_partial(
-                resume, example, example_buffer=example_buffer,
-                example_extra={"episode": _np.asarray(0, _np.int32)})
-            if buffer_ok:
-                init_buffer = restored["buffer"]
-            else:
-                init_buffer = None
-                click.echo("[resume] replay buffer not restorable (legacy "
-                           "storage format, or replay config such as "
-                           "mem_limit changed since the checkpoint) — "
-                           "restored state only, replay starts empty",
-                           err=True)
-            init_state = restored["state"]
-            start_episode = int(restored["extra"]["episode"]) \
-                if "extra" in restored else 0
-            if start_episode >= episodes:
-                # range(start, episodes) would be empty: no training, but
-                # the checkpoint would be REWRITTEN with the smaller
-                # counter — corrupting exact resume for later runs
-                raise click.BadParameter(
-                    f"--episodes ({episodes}) must exceed the checkpoint's "
-                    f"completed episode count ({start_episode})")
-        result.runtime_start("train")
-        if replicas > 1:
-            state, buffer = trainer.train_parallel(
-                episodes, num_replicas=replicas, chunk=chunk,
-                verbose=verbose, profile=profile, init_state=init_state,
-                init_buffers=init_buffer, start_episode=start_episode)
-        else:
-            state, buffer = trainer.train(episodes, verbose=verbose,
-                                          profile=profile,
-                                          init_state=init_state,
-                                          init_buffer=init_buffer,
-                                          start_episode=start_episode,
-                                          pipeline=pipeline)
-        result.runtime_stop("train")
+        obs = None
+        if obs_enabled:
+            from .obs import RunObserver
 
-        ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
-                               buffer=buffer,
-                               extra={"episode": _np.asarray(episodes, _np.int32)})
-        result.runtime_start("test")
-        test = trainer.evaluate(state, episodes=1, test_mode=True,
-                                telemetry=True)
-        result.runtime_stop("test")
+            # with --runs N and an explicit --obs-dir, each run gets its
+            # own subdirectory so the event streams never interleave
+            odir = obs_dir or rdir
+            if obs_dir and runs > 1:
+                odir = os.path.join(obs_dir, f"run{run}")
+            obs = RunObserver(odir, snapshot_interval=obs_interval,
+                              watchdog_budget_s=watchdog_budget,
+                              tags={"seed": run_seed})
+            obs.start(meta={"episodes": episodes, "replicas": replicas,
+                            "pipeline": pipeline, "seed": run_seed,
+                            "result_dir": rdir})
+        trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
+                          tensorboard=tensorboard, obs=obs,
+                          check_invariants=check_invariants)
+        try:
+            # everything from here on runs under the observer: a failed
+            # resume restore (or bad --episodes) must still land the
+            # run_end status=error tail before propagating
+            init_state = init_buffer = None
+            start_episode = 0
+            if resume:
+                from .utils.checkpoint import load_full_or_partial
+                topo0, traffic0 = driver.episode(0, False)
+                _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
+                example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
+                if replicas > 1:
+                    # replica-sharded replay: [B, capacity, ...] leaves — a
+                    # checkpoint from a matching --replicas run restores
+                    # fully; anything else falls back to state-only
+                    from .parallel import ParallelDDPG
+                    example_buffer = ParallelDDPG(
+                        env, agent, num_replicas=replicas).init_buffers(obs0)
+                else:
+                    example_buffer = trainer.ddpg.init_buffer(obs0)
+                restored, buffer_ok = load_full_or_partial(
+                    resume, example, example_buffer=example_buffer,
+                    example_extra={"episode": _np.asarray(0, _np.int32)})
+                if buffer_ok:
+                    init_buffer = restored["buffer"]
+                else:
+                    init_buffer = None
+                    click.echo("[resume] replay buffer not restorable "
+                               "(legacy storage format, or replay config "
+                               "such as mem_limit changed since the "
+                               "checkpoint) — restored state only, replay "
+                               "starts empty", err=True)
+                init_state = restored["state"]
+                start_episode = int(restored["extra"]["episode"]) \
+                    if "extra" in restored else 0
+                if start_episode >= episodes:
+                    # range(start, episodes) would be empty: no training,
+                    # but the checkpoint would be REWRITTEN with the
+                    # smaller counter — corrupting exact resume for later
+                    # runs
+                    raise click.BadParameter(
+                        f"--episodes ({episodes}) must exceed the "
+                        f"checkpoint's completed episode count "
+                        f"({start_episode})")
+            result.runtime_start("train")
+            if replicas > 1:
+                state, buffer = trainer.train_parallel(
+                    episodes, num_replicas=replicas, chunk=chunk,
+                    verbose=verbose, profile=profile, init_state=init_state,
+                    init_buffers=init_buffer, start_episode=start_episode)
+            else:
+                state, buffer = trainer.train(episodes, verbose=verbose,
+                                              profile=profile,
+                                              init_state=init_state,
+                                              init_buffer=init_buffer,
+                                              start_episode=start_episode,
+                                              pipeline=pipeline)
+            result.runtime_stop("train")
+
+            ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
+                                   buffer=buffer,
+                                   extra={"episode": _np.asarray(episodes,
+                                                                 _np.int32)})
+            result.runtime_start("test")
+            test = trainer.evaluate(state, episodes=1, test_mode=True,
+                                    telemetry=True)
+            result.runtime_stop("test")
+        except BaseException:
+            # the run's final events (run_end status=error + a last
+            # snapshot) must land even when training faults — that tail
+            # is exactly what post-mortems read.  Best effort: a close
+            # that itself fails (e.g. the same full disk that killed the
+            # run) must not mask the original traceback.
+            if obs is not None:
+                try:
+                    obs.close(status="error")
+                except Exception:
+                    pass
+            raise
+        if obs is not None:
+            obs.close(status="ok")
         result.metrics = test
         result.write()
         outputs[rdir] = {"result_dir": rdir, "checkpoint": ckpt, **test}

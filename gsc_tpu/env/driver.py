@@ -89,13 +89,18 @@ class EpisodeDriver:
         return topo, self.traffic_for(episode, topo, seed)
 
     def prefetcher(self, start: int, stop: int, test_mode: bool = False,
-                   depth: int = 2,
-                   stage: Optional[Callable] = None) -> "EpisodePrefetcher":
+                   depth: int = 2, stage: Optional[Callable] = None,
+                   heartbeat: Optional[Callable] = None
+                   ) -> "EpisodePrefetcher":
         """Background double buffer over ``episode``: episode k+1's traffic
         is sampled (and optionally staged to device via ``stage``) while
-        episode k's rollout runs on the accelerator."""
+        episode k's rollout runs on the accelerator.  ``heartbeat`` (e.g.
+        the obs hub's prefetcher beat) is called from the producer thread
+        after every staged episode so a watchdog can tell a dead producer
+        from one blocked on a full queue."""
         return EpisodePrefetcher(self, start, stop, test_mode=test_mode,
-                                 depth=depth, stage=stage)
+                                 depth=depth, stage=stage,
+                                 heartbeat=heartbeat)
 
 
 class EpisodePrefetcher:
@@ -120,24 +125,36 @@ class EpisodePrefetcher:
 
     def __init__(self, driver: EpisodeDriver, start: int, stop: int,
                  test_mode: bool = False, depth: int = 2,
-                 stage: Optional[Callable] = None):
+                 stage: Optional[Callable] = None,
+                 heartbeat: Optional[Callable] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.driver = driver
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop_flag = threading.Event()
-        self._args = (start, stop, test_mode, stage)
+        self._args = (start, stop, test_mode, stage, heartbeat)
         self._thread = threading.Thread(
             target=self._produce, name="gsc-episode-prefetch", daemon=True)
         self._thread.start()
 
+    @property
+    def queue_depth(self) -> int:
+        """Episodes currently staged (approximate — the producer races)."""
+        return self._queue.qsize()
+
+    def is_alive(self) -> bool:
+        """Producer-thread liveness (watchdog stall-event probe)."""
+        return self._thread.is_alive()
+
     def _produce(self):
-        start, stop, test_mode, stage = self._args
+        start, stop, test_mode, stage, heartbeat = self._args
         try:
             for ep in range(start, stop):
                 item = self.driver.episode(ep, test_mode)
                 if stage is not None:
                     item = stage(*item)
+                if heartbeat is not None:
+                    heartbeat()
                 # bounded put, polled so close() can abandon a full queue
                 while not self._stop_flag.is_set():
                     try:

@@ -1,0 +1,43 @@
+"""Run observability: metrics hub, event stream, device gauges, watchdog.
+
+The training loop's only window used to be a ``PhaseTimer`` dict printed at
+loop end — no way to see a stall, a leaking HBM buffer, or a collapsing
+reward curve *while* a long run is in flight, and no machine-readable
+record to compare runs afterward.  Podracer (arXiv:2104.06272) and
+MindSpeed RL (arXiv:2507.19017) both treat per-component throughput /
+utilization telemetry as a first-class requirement for keeping accelerator
+pipelines honest; this package is that substrate:
+
+- :class:`MetricsHub` — process-wide counters / gauges / histograms,
+  tagged by run/replica, thread-safe (the prefetcher and watchdog threads
+  write into it concurrently with the training loop).
+- :class:`JsonlSink` — per-run ``events.jsonl``: one structured record per
+  episode (SPS, per-phase host timings, learner losses/grad-norms, sim
+  drop-reason totals, truncated-arrival counts, replay-buffer bytes,
+  device memory) plus ``run_start`` / ``stall`` / ``invariant_violation``
+  / ``run_end`` records.
+- :func:`write_atomic_json` — ``metrics.json`` snapshot exposition,
+  rewritten atomically every N episodes with Prometheus-text-style flat
+  names so external scrapers/tail tools can poll a live run.
+- :mod:`~gsc_tpu.obs.device` — HBM gauges from
+  ``jax.local_devices()[*].memory_stats()`` sampled each drain.
+- :class:`PipelineWatchdog` — heartbeats the prefetcher thread and the
+  dispatch→drain lag; emits a structured ``stall`` event when no episode
+  finishes within a wall budget.
+- :mod:`~gsc_tpu.obs.trace` — ``jax.profiler`` annotations so ``--profile``
+  traces attribute device time to pipeline phases.
+- :class:`RunObserver` — the facade the trainer/CLI wire through.
+
+All later perf PRs report through this subsystem.
+"""
+from .device import device_memory_snapshot, record_device_gauges
+from .hub import MetricsHub
+from .run import RunObserver
+from .sinks import JsonlSink, ListSink, write_atomic_json
+from .watchdog import PipelineWatchdog
+
+__all__ = [
+    "MetricsHub", "JsonlSink", "ListSink", "write_atomic_json",
+    "device_memory_snapshot", "record_device_gauges", "PipelineWatchdog",
+    "RunObserver",
+]

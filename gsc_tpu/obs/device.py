@@ -1,0 +1,51 @@
+"""Device/HBM gauges from ``jax.local_devices()[*].memory_stats()``.
+
+On TPU/GPU backends ``memory_stats()`` reports allocator state
+(``bytes_in_use``, ``peak_bytes_in_use``, ``bytes_limit``, ...); the CPU
+backend returns ``None``.  Records keep one entry per local device either
+way, with ``available`` flagging whether the backend exposes the stats —
+the events.jsonl schema is stable across backends, so a report written
+against a CPU smoke run reads a TPU run unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# the allocator keys worth streaming; other backend-specific entries
+# (num_allocs, largest_alloc_size, ...) stay out of the per-episode record
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_snapshot() -> List[Dict]:
+    """One record per local device: ``{"device", "available", and (when the
+    backend exposes allocator stats) bytes_in_use/peak_bytes_in_use/
+    bytes_limit}``."""
+    import jax
+
+    records = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:   # backends without the API raise rather than
+            stats = None    # return None (older plugin versions)
+        rec: Dict = {"device": str(d), "available": bool(stats)}
+        if stats:
+            for k in _KEYS:
+                if k in stats:
+                    rec[k] = int(stats[k])
+        records.append(rec)
+    return records
+
+
+def record_device_gauges(hub, records: Optional[List[Dict]] = None
+                         ) -> List[Dict]:
+    """Sample (or reuse) a memory snapshot and mirror it into hub gauges
+    tagged by device — ``gsc_device_bytes_in_use{device="TPU_0"}`` etc. in
+    the metrics.json exposition."""
+    if records is None:
+        records = device_memory_snapshot()
+    for rec in records:
+        for k in _KEYS:
+            if k in rec:
+                hub.gauge(f"device_{k}", rec[k], device=rec["device"])
+    return records

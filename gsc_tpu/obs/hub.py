@@ -1,0 +1,208 @@
+"""Process-wide metrics hub: counters, gauges, histograms, heartbeats.
+
+One :class:`MetricsHub` per run.  Writers are the training loop, the
+prefetcher thread (heartbeats) and the watchdog thread (stall events), so
+every mutation takes the hub lock — all operations are O(1) dict updates
+plus a bounded-deque append, cheap enough for per-episode cadence.
+
+Names follow Prometheus conventions: ``snapshot()`` flattens every series
+to ``gsc_<name>{tag="value",...}`` text-exposition keys (histograms expand
+to ``_count``/``_sum``/``_min``/``_max``/``_p50``/``_p90``/``_p99``), so a
+``metrics.json`` written from it can be tailed or scraped without knowing
+the hub's internal structure.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# percentile window: enough samples to make p99 meaningful over a long run
+# without unbounded memory; a run logging 1 episode/s holds ~17 min of
+# history, which is the window a live-tail debugging session cares about
+_HIST_WINDOW = 1024
+_PCTS = ((0.50, "p50"), (0.90, "p90"), (0.99, "p99"))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class _Histogram:
+    __slots__ = ("count", "total", "vmin", "vmax", "window")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.window = deque(maxlen=_HIST_WINDOW)
+
+    def observe(self, value: float):
+        self.count += 1
+        self.total += value
+        self.vmin = min(self.vmin, value)
+        self.vmax = max(self.vmax, value)
+        self.window.append(value)
+
+    def summary(self) -> Dict[str, float]:
+        vals = sorted(self.window)
+        out = {"count": float(self.count), "sum": self.total,
+               "min": self.vmin if self.count else 0.0,
+               "max": self.vmax if self.count else 0.0,
+               "mean": self.total / self.count if self.count else 0.0}
+        for q, label in _PCTS:
+            out[label] = _percentile(vals, q)
+        return out
+
+
+# a series key is (name, sorted tag items) — hashable and order-insensitive
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, tags: Dict[str, object]) -> _Key:
+    return name, tuple(sorted((k, str(v)) for k, v in tags.items()))
+
+
+def flat_name(name: str, tags: Iterable[Tuple[str, str]]) -> str:
+    """Prometheus-text-style series name: ``gsc_name{k="v",...}``."""
+    label = ",".join(f'{k}="{v}"' for k, v in tags)
+    return f"gsc_{name}{{{label}}}" if label else f"gsc_{name}"
+
+
+class MetricsHub:
+    """Counters, gauges and histograms tagged by run/replica, plus the
+    heartbeat registry the :class:`~gsc_tpu.obs.watchdog.PipelineWatchdog`
+    polls and the event fan-out the JSONL stream hangs off."""
+
+    def __init__(self, tags: Optional[Dict[str, object]] = None):
+        self._lock = threading.RLock()
+        self.base_tags: Dict[str, str] = {
+            k: str(v) for k, v in (tags or {}).items()}
+        self._counters: Dict[_Key, float] = {}
+        self._gauges: Dict[_Key, float] = {}
+        self._hists: Dict[_Key, _Histogram] = {}
+        self._beats: Dict[str, float] = {}       # name -> time.monotonic()
+        self._last_phase: Optional[str] = None
+        self._last_phase_done = False
+        self._sinks: list = []
+
+    # ------------------------------------------------------------- series
+    def counter(self, name: str, inc: float = 1.0, **tags) -> float:
+        """Monotonic counter; returns the new value."""
+        k = _key(name, tags)
+        with self._lock:
+            val = self._counters.get(k, 0.0) + inc
+            self._counters[k] = val
+            return val
+
+    def get_counter(self, name: str, **tags) -> float:
+        with self._lock:
+            return self._counters.get(_key(name, tags), 0.0)
+
+    def gauge(self, name: str, value: float, **tags):
+        """Point-in-time value (last write wins)."""
+        with self._lock:
+            self._gauges[_key(name, tags)] = float(value)
+
+    def get_gauge(self, name: str, **tags) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(_key(name, tags))
+
+    def observe(self, name: str, value: float, **tags):
+        """Histogram sample (count/sum/min/max + windowed percentiles)."""
+        k = _key(name, tags)
+        with self._lock:
+            hist = self._hists.get(k)
+            if hist is None:
+                hist = self._hists[k] = _Histogram()
+            hist.observe(float(value))
+
+    def histogram_summary(self, name: str, **tags) -> Optional[Dict[str, float]]:
+        with self._lock:
+            h = self._hists.get(_key(name, tags))
+            return h.summary() if h else None
+
+    # --------------------------------------------------------- heartbeats
+    def beat(self, name: str):
+        """Record liveness of a component (trainer loop, prefetcher, ...)."""
+        with self._lock:
+            self._beats[name] = time.monotonic()
+
+    def beat_age(self, name: str) -> Optional[float]:
+        """Seconds since ``name`` last beat; None if it never has."""
+        with self._lock:
+            t = self._beats.get(name)
+        return None if t is None else time.monotonic() - t
+
+    def beat_time(self, name: str) -> Optional[float]:
+        """Raw monotonic timestamp of the last beat (watchdog re-arm key)."""
+        with self._lock:
+            return self._beats.get(name)
+
+    def beat_ages(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            return {n: round(now - t, 3) for n, t in self._beats.items()}
+
+    # ---------------------------------------------------- phase bookkeeping
+    def note_phase(self, name: str, done: bool = False):
+        """Track the pipeline phase currently executing (``done=False``) or
+        just finished (``done=True``) — a stall event reports both so a hang
+        points at the phase it is stuck *in*."""
+        with self._lock:
+            self._last_phase = name
+            self._last_phase_done = done
+
+    @property
+    def last_phase(self) -> Tuple[Optional[str], bool]:
+        with self._lock:
+            return self._last_phase, self._last_phase_done
+
+    # -------------------------------------------------------------- events
+    def add_sink(self, sink):
+        with self._lock:
+            self._sinks.append(sink)
+
+    def event(self, kind: str, **fields) -> Dict[str, object]:
+        """Emit one structured record to every sink; returns the record.
+        Base tags (run id, ...) merge in under the caller's fields."""
+        record = {"event": kind, "ts": round(time.time(), 3),
+                  **self.base_tags, **fields}
+        with self._lock:
+            sinks = list(self._sinks)
+        for sink in sinks:
+            sink.emit(record)
+        return record
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> Dict[str, float]:
+        """Flat ``{prometheus_name: value}`` view of every live series."""
+        with self._lock:
+            base = tuple(self.base_tags.items())
+            merge = lambda tags: tuple(sorted(base + tags))
+            out: Dict[str, float] = {}
+            for (name, tags), v in self._counters.items():
+                out[flat_name(name, merge(tags))] = v
+            for (name, tags), v in self._gauges.items():
+                out[flat_name(name, merge(tags))] = v
+            for (name, tags), h in self._hists.items():
+                s = h.summary()
+                for suffix in ("count", "sum", "min", "max", "p50", "p90",
+                               "p99"):
+                    out[flat_name(f"{name}_{suffix}", merge(tags))] = s[suffix]
+            return out
+
+    def close(self):
+        with self._lock:
+            sinks, self._sinks = list(self._sinks), []
+        for sink in sinks:
+            try:
+                sink.close()
+            except Exception:  # a failing sink must not mask run teardown
+                pass

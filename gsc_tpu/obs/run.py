@@ -1,0 +1,181 @@
+"""RunObserver — the facade one training run wires through.
+
+Owns the hub, the ``events.jsonl`` sink, the ``metrics.json`` snapshot
+cadence and the watchdog for a single run directory.  The trainer calls
+:meth:`episode_dispatched` / :meth:`episode_end`; everything else (device
+gauges, snapshot rewrites, heartbeats, stall monitoring) happens here so
+the training loop stays readable.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .device import record_device_gauges
+from .hub import MetricsHub
+from .sinks import JsonlSink, write_atomic_json
+from .watchdog import PipelineWatchdog
+
+# phases whose per-episode wall deltas are worth percentile tracking
+_PHASE_HIST = ("host_sample", "host_sample_wait", "dispatch", "drain")
+
+
+class RunObserver:
+    """Per-run observability: hub + JSONL events + atomic snapshots +
+    watchdog, all rooted in one output directory."""
+
+    def __init__(self, out_dir: str, run_id: Optional[str] = None,
+                 snapshot_interval: int = 10,
+                 watchdog_budget_s: float = 0.0,
+                 tags: Optional[Dict[str, object]] = None):
+        self.out_dir = os.path.abspath(out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
+        self.hub = MetricsHub(tags={"run": run_id, **(tags or {})})
+        self.events_path = os.path.join(self.out_dir, "events.jsonl")
+        self.snapshot_path = os.path.join(self.out_dir, "metrics.json")
+        self.hub.add_sink(JsonlSink(self.events_path))
+        self.snapshot_interval = max(int(snapshot_interval), 1)
+        self.watchdog: Optional[PipelineWatchdog] = None
+        if watchdog_budget_s and watchdog_budget_s > 0:
+            # paused until the trainer enters its episode loop — eval /
+            # checkpoint time between loops must not read as a pipeline
+            # stall.  (First-dispatch jit compile happens INSIDE the loop
+            # and does count: a stall with episodes_drained=0 carries a
+            # note saying compile may dominate it.)
+            self.watchdog = PipelineWatchdog(self.hub, watchdog_budget_s,
+                                             start_paused=True)
+        self._drained = 0
+        self._prev_phase_totals: Dict[str, float] = {}
+        self._started = False
+        self._closed = False
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, meta: Optional[Dict] = None) -> "RunObserver":
+        if self._started:
+            return self
+        self._started = True
+        self.hub.event("run_start", **(meta or {}))
+        if self.watchdog is not None:
+            self.watchdog.start()
+        return self
+
+    def close(self, status: str = "ok"):
+        """Final snapshot + ``run_end`` event; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        try:
+            self.hub.event("run_end", status=status,
+                           episodes=self._drained,
+                           stalls=self.hub.get_counter("stalls"))
+            self.write_snapshot()
+        finally:
+            self.hub.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, *exc):
+        self.close(status="error" if exc_type else "ok")
+        return False
+
+    # ------------------------------------------------------------ plumbing
+    def resume_watchdog(self):
+        if self.watchdog is not None:
+            self.watchdog.resume()
+
+    def pause_watchdog(self):
+        if self.watchdog is not None:
+            self.watchdog.pause()
+
+    def prefetcher_heartbeat(self):
+        """Bound callable handed to ``EpisodeDriver.prefetcher`` — beats
+        from the producer thread after every staged episode."""
+        return lambda: self.hub.beat("prefetcher")
+
+    def attach_prefetcher(self, prefetcher):
+        """Register stall-event probes over a live prefetcher: queue depth
+        and producer-thread liveness."""
+        if self.watchdog is not None:
+            self.watchdog.register_probe(
+                "prefetch_queue_depth", lambda: prefetcher.queue_depth)
+            self.watchdog.register_probe(
+                "prefetcher_alive", lambda: prefetcher.is_alive())
+
+    # ------------------------------------------------------------- episodes
+    def episode_dispatched(self, episode: int):
+        self.hub.counter("episodes_dispatched")
+        self.hub.beat("dispatch")
+
+    def episode_end(self, episode: int, global_step: int,
+                    metrics: Dict[str, float], sps: float,
+                    phases: Dict[str, Dict[str, float]],
+                    drop_reasons: Optional[Dict[str, int]] = None,
+                    truncated_arrivals: int = 0,
+                    replay_bytes: Optional[int] = None,
+                    extra: Optional[Dict] = None) -> Dict:
+        """One drained episode: update hub series, sample device memory,
+        emit the ``episode`` event, heartbeat the watchdog, and rewrite
+        the snapshot every ``snapshot_interval`` episodes.
+
+        ``phases`` is the cumulative ``PhaseTimer.summary()``; per-episode
+        deltas are derived here and fed to the phase histograms."""
+        self._drained += 1
+        self.hub.counter("episodes_drained")
+        self.hub.gauge("sps", sps)
+        self.hub.gauge("episode", episode)
+        for k, v in metrics.items():
+            try:
+                self.hub.gauge(k, float(v))
+            except (TypeError, ValueError):
+                pass   # non-scalar stat (kept in the event record only)
+        if replay_bytes is not None:
+            self.hub.gauge("replay_bytes", replay_bytes)
+        if truncated_arrivals:
+            self.hub.counter("truncated_arrivals_total", truncated_arrivals)
+        for reason, n in (drop_reasons or {}).items():
+            if n:
+                self.hub.counter("sim_drops_total", n, reason=reason)
+        for name in _PHASE_HIST:
+            total = phases.get(name, {}).get("total_s")
+            if total is None:
+                continue
+            delta = total - self._prev_phase_totals.get(name, 0.0)
+            self._prev_phase_totals[name] = total
+            self.hub.observe("phase_s", delta, phase=name)
+        device_memory = record_device_gauges(self.hub)
+        record = self.hub.event(
+            "episode", episode=episode, global_step=global_step,
+            sps=round(sps, 3), **metrics,
+            drop_reasons=drop_reasons or {},
+            truncated_arrivals=truncated_arrivals,
+            replay_bytes=replay_bytes,
+            phases=phases, device_memory=device_memory,
+            **(extra or {}))
+        self.hub.beat("episode")
+        if self._drained % self.snapshot_interval == 0:
+            self.write_snapshot()
+        return record
+
+    def eval_episode(self, episode: int, episodic_return: float,
+                     succ_ratio: float, runtime_s: float):
+        self.hub.counter("eval_episodes")
+        device_memory = record_device_gauges(self.hub)
+        self.hub.event("eval_episode", episode=episode,
+                       episodic_return=episodic_return,
+                       succ_ratio=succ_ratio,
+                       runtime_s=round(runtime_s, 4),
+                       device_memory=device_memory)
+
+    # ------------------------------------------------------------ snapshot
+    def write_snapshot(self) -> str:
+        import time
+
+        return write_atomic_json(self.snapshot_path, {
+            "ts": round(time.time(), 3),
+            "run": self.hub.base_tags.get("run"),
+            "metrics": self.hub.snapshot(),
+        })

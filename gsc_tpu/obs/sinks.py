@@ -1,0 +1,91 @@
+"""Event sinks + atomic snapshot writer for the metrics hub.
+
+``events.jsonl`` is append-only (one JSON object per line — safe to tail
+while a run is in flight); ``metrics.json`` is a whole-file snapshot
+rewritten atomically (temp file + ``os.replace``) so a poller never reads
+a half-written document.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+
+def jsonable(obj):
+    """Best-effort conversion of event-record leaves to JSON types —
+    device scalars and numpy arrays show up in episode stats."""
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()   # 0-d jax arrays without importing jax here
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return str(obj)
+
+
+class JsonlSink:
+    """Append-only JSONL event stream; every record flushed so a live run
+    can be tailed.  ``emit`` is called from the training loop AND the
+    watchdog thread — serialized by a lock."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self._lock = threading.Lock()
+        self._file = open(path, "a")
+
+    def emit(self, record: Dict):
+        line = json.dumps(record, default=jsonable)
+        with self._lock:
+            if self._file is None:
+                return   # late event after close (e.g. watchdog teardown)
+            self._file.write(line + "\n")
+            self._file.flush()
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+class ListSink:
+    """In-memory sink for tests and the report selftest."""
+
+    def __init__(self):
+        self.records: List[Dict] = []
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict):
+        with self._lock:
+            # round-trip through JSON so tests see exactly what a JSONL
+            # reader would — schema drift fails here, not in production
+            self.records.append(json.loads(json.dumps(record,
+                                                      default=jsonable)))
+
+    def of_kind(self, kind: str) -> List[Dict]:
+        with self._lock:
+            return [r for r in self.records if r.get("event") == kind]
+
+    def close(self):
+        pass
+
+
+def write_atomic_json(path: str, obj) -> str:
+    """Write ``obj`` as JSON via temp-file + ``os.replace`` so concurrent
+    readers always see a complete document."""
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, default=jsonable, indent=0, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path

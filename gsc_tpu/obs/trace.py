@@ -1,0 +1,49 @@
+"""Profiler trace annotations for the episode pipeline.
+
+A ``--profile`` trace of the pipelined trainer used to be one opaque blob:
+the fused rollout+learn program, the prefetch waits and the metric drains
+all interleave with nothing attributing device time to pipeline phases.
+These helpers wrap the host-side phases in ``jax.profiler.TraceAnnotation``
+(named ranges on the host timeline that the trace viewer correlates with
+the device stream) and each episode dispatch in
+``jax.profiler.StepTraceAnnotation`` (the step marker TensorBoard's
+profiler uses for per-step device attribution).
+
+Annotation names are stable API — tooling and docs reference them:
+``host_sample``, ``host_sample_wait``, ``dispatch``, ``drain`` (phase
+ranges) and ``episode_step`` (the per-episode step marker).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def phase_span(name: str, timer=None, hub=None):
+    """One pipeline phase: profiler range + optional
+    :class:`~gsc_tpu.utils.telemetry.PhaseTimer` accumulation + hub
+    last-phase bookkeeping (what a stall event reports being stuck in)."""
+    import jax
+
+    if hub is not None:
+        hub.note_phase(name, done=False)
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            if timer is not None:
+                with timer.phase(name):
+                    yield
+            else:
+                yield
+        finally:
+            if hub is not None:
+                hub.note_phase(name, done=True)
+
+
+@contextmanager
+def episode_span(step: int, name: str = "episode_step"):
+    """Step marker around one episode's device dispatch, so profiler UIs
+    attribute device time per episode instead of one run-length blob."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=int(step)):
+        yield

@@ -1,0 +1,136 @@
+"""Pipeline watchdog: a heartbeat monitor over the asynchronous episode
+pipeline.
+
+The pipelined trainer can hang in ways a log file never shows: the
+prefetcher thread deadlocks on a full queue, a device call faults and the
+drain blocks forever, host sampling livelocks.  The watchdog polls the
+hub's ``episode`` heartbeat (beaten after every drained episode) and, when
+no episode completes within the wall budget, emits ONE structured
+``stall`` event carrying the last pipeline phase entered/completed, the
+dispatch→drain lag, every component's heartbeat age, and any registered
+probes (prefetch queue depth, thread liveness).  It re-arms after the next
+completed episode, so an intermittent stall produces one event per
+occurrence rather than a flood.
+
+The thread is a daemon and holds no JAX state — it can never wedge the
+device or outlive the process.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .hub import MetricsHub
+
+
+class PipelineWatchdog:
+    """Emits ``stall`` events when the ``episode`` heartbeat goes quiet.
+
+    ``start_paused=True`` (the trainer wiring) keeps the monitor disarmed
+    until :meth:`resume` — evaluation, checkpointing and other between-loop
+    work must not count against the episode wall budget.
+    """
+
+    def __init__(self, hub: MetricsHub, budget_s: float,
+                 beat_name: str = "episode",
+                 poll_s: Optional[float] = None,
+                 start_paused: bool = False):
+        if budget_s <= 0:
+            raise ValueError(f"watchdog budget must be > 0, got {budget_s}")
+        self.hub = hub
+        self.budget_s = float(budget_s)
+        self.beat_name = beat_name
+        # poll fast enough to flag a stall well inside one extra budget
+        # interval, but never busier than 4 Hz
+        self.poll_s = poll_s if poll_s is not None else max(
+            min(self.budget_s / 4.0, 1.0), 0.25)
+        self._probes: Dict[str, Callable[[], object]] = {}
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        if start_paused:
+            self._paused.set()
+        self._stalled = False
+        self._stalled_at_beat: Optional[float] = None
+        self.stall_count = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name="gsc-pipeline-watchdog",
+                                        daemon=True)
+
+    # ------------------------------------------------------------ control
+    def register_probe(self, name: str, fn: Callable[[], object]):
+        """Attach a diagnostic callable whose value is included in stall
+        events (e.g. prefetch queue depth)."""
+        self._probes[name] = fn
+
+    def start(self):
+        self.hub.beat(self.beat_name)   # arm: age measured from start
+        self._thread.start()
+        return self
+
+    def resume(self):
+        """Arm the monitor (trainer entering its episode loop).  Beats once
+        so paused time never counts toward the budget."""
+        self.hub.beat(self.beat_name)
+        self._stalled = False
+        self._stalled_at_beat = None
+        self._paused.clear()
+
+    def pause(self):
+        """Disarm (trainer left the episode loop)."""
+        self._paused.set()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    # --------------------------------------------------------------- loop
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            if self._paused.is_set():
+                continue
+            age = self.hub.beat_age(self.beat_name)
+            if age is None:
+                continue
+            # re-arm on any heartbeat NEWER than the one the last stall
+            # was declared against — comparing timestamps (not current
+            # age) means a short recovery between two stalls re-arms even
+            # when no poll tick happens to land inside it
+            if self._stalled and \
+                    self.hub.beat_time(self.beat_name) != self._stalled_at_beat:
+                self._stalled = False
+            if age > self.budget_s and not self._stalled:
+                self._stalled = True
+                self._stalled_at_beat = self.hub.beat_time(self.beat_name)
+                self.stall_count += 1
+                self._emit_stall(age)
+
+    def _emit_stall(self, age: float):
+        phase, done = self.hub.last_phase
+        fields: Dict[str, object] = {
+            "age_s": round(age, 3),
+            "budget_s": self.budget_s,
+            "last_phase": phase,
+            "last_phase_state": "completed" if done else "running",
+            "episodes_dispatched": self.hub.get_counter(
+                "episodes_dispatched"),
+            "episodes_drained": self.hub.get_counter("episodes_drained"),
+            "heartbeats": self.hub.beat_ages(),
+        }
+        fields["dispatch_drain_lag"] = (
+            fields["episodes_dispatched"] - fields["episodes_drained"])
+        if fields["episodes_drained"] == 0:
+            # a genuinely overdue FIRST episode still deserves the event
+            # (that hang is invisible otherwise), but on a cold compile
+            # cache the first fused dispatch's XLA compile can dominate
+            # this interval — say so instead of crying wolf
+            fields["note"] = ("no episode has completed yet — a cold "
+                              "first-dispatch compile can dominate this "
+                              "interval")
+        for name, fn in self._probes.items():
+            try:
+                fields[name] = fn()
+            except Exception as e:   # a dead probe is itself a diagnostic
+                fields[name] = f"probe-error: {e!r}"
+        self.hub.counter("stalls")
+        self.hub.event("stall", **fields)

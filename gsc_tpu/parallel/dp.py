@@ -168,6 +168,10 @@ class ParallelDDPG:
             "mean_succ_ratio": stats["succ_ratio"].mean(),
             "mean_e2e_delay": stats["avg_e2e_delay"].mean(),
             "final_succ_ratio": stats["succ_ratio"][-1].mean(),
+            # [B] per-replica returns ride along for telemetry: the obs
+            # hub tags replica-resolved gauges from them (a collapsing
+            # replica is invisible in the cross-replica mean)
+            "per_replica_return": stats["reward"].sum(0),
         }
         return (state.replace(rng=rng), buffers, env_states, obs,
                 episode_stats)

@@ -8,6 +8,14 @@ in the same device program, and per-episode metrics are aggregated over
 ALL chunks: ``episodic_return`` sums across chunks and the success ratio
 averages them — a single chunk's stats cover only that chunk's steps, so
 reading the last chunk would score episodes on an end-of-episode slice.
+
+With ``hub`` (a :class:`gsc_tpu.obs.MetricsHub`) the harness streams
+replica-resolved telemetry: per-replica episode returns and replay-shard
+fill as gauges tagged ``replica=<i>``, plus one ``harness_episode`` event
+per episode — a collapsing replica or a starved replay shard is invisible
+in the cross-replica means the quality tools report.  ``timer`` (a
+``PhaseTimer``) attributes the chunk-dispatch loop vs the metric-sync wall
+exactly like the single-env trainer's dispatch/drain phases.
 """
 from __future__ import annotations
 
@@ -15,13 +23,15 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                          state, buffers, episodes: int, episode_steps: int,
                          chunk: int, seed: int,
                          on_episode: Optional[Callable] = None,
-                         step_offset: int = 0
+                         step_offset: int = 0,
+                         hub=None, timer=None
                          ) -> Tuple[object, object, list, list, list]:
     """Train for ``episodes`` full episodes; returns (state, buffers,
     per-episode returns, per-episode MEAN success ratios, per-episode
@@ -40,6 +50,8 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
     agent's warmup gate (global_step < nb_steps_warmup_critic selects
     random actions) would restart at 0 every episode and the policy would
     never act."""
+    from ..obs.trace import phase_span
+
     assert episode_steps % chunk == 0, (episode_steps, chunk)
     returns, succ, final_succ = [], [], []
     for ep in range(episodes):
@@ -49,26 +61,52 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
             topo, traffic)
         chunk_stats = []
         n_chunks = episode_steps // chunk
-        for c in range(n_chunks):
-            start = jnp.int32(step_offset + ep * episode_steps + c * chunk)
-            # the FINAL chunk fuses the end-of-episode learn burst into the
-            # same device program (ParallelDDPG.chunk_step) — no host
-            # round-trip between the last rollout call and the learner;
-            # results are bit-identical to the two-call path
-            state, buffers, env_states, obs, stats, metrics = \
-                pddpg.chunk_step(state, buffers, env_states, obs, topo,
-                                 traffic, start, chunk,
-                                 learn=(c == n_chunks - 1))
-            chunk_stats.append(stats)   # device scalars: convert AFTER the
-            # episode is dispatched — a float() here would sync the host
-            # every chunk and depress the measured wall rate
-        returns.append(sum(float(s["episodic_return"])
-                           for s in chunk_stats))
-        succ.append(sum(float(s["mean_succ_ratio"]) for s in chunk_stats)
-                    / len(chunk_stats))
-        # end-of-episode slice: the final step's success ratio, comparable
-        # to Trainer stats / the historical BENCH quality bars
-        final_succ.append(float(chunk_stats[-1]["final_succ_ratio"]))
+        with phase_span("dispatch", timer, hub):
+            for c in range(n_chunks):
+                start = jnp.int32(step_offset + ep * episode_steps
+                                  + c * chunk)
+                # the FINAL chunk fuses the end-of-episode learn burst into
+                # the same device program (ParallelDDPG.chunk_step) — no
+                # host round-trip between the last rollout call and the
+                # learner; results are bit-identical to the two-call path
+                state, buffers, env_states, obs, stats, metrics = \
+                    pddpg.chunk_step(state, buffers, env_states, obs, topo,
+                                     traffic, start, chunk,
+                                     learn=(c == n_chunks - 1))
+                chunk_stats.append(stats)   # device scalars: convert AFTER
+                # the episode is dispatched — a float() here would sync the
+                # host every chunk and depress the measured wall rate
+        with phase_span("drain", timer, hub):
+            returns.append(sum(float(s["episodic_return"])
+                               for s in chunk_stats))
+            succ.append(sum(float(s["mean_succ_ratio"])
+                            for s in chunk_stats) / len(chunk_stats))
+            # end-of-episode slice: the final step's success ratio,
+            # comparable to Trainer stats / the historical BENCH quality
+            # bars
+            final_succ.append(float(chunk_stats[-1]["final_succ_ratio"]))
+        if hub is not None:
+            # replica-resolved telemetry (the harness's own series — the
+            # episodes_* counters belong to whoever drives the run).  The
+            # event carries the GLOBAL episode index: per-episode drivers
+            # (train_parallel) call with episodes=1 and a step_offset, so
+            # the loop-local ep alone would stamp every record episode=0.
+            global_ep = step_offset // episode_steps + ep
+            per_rep = [np.asarray(s["per_replica_return"])
+                       for s in chunk_stats if "per_replica_return" in s]
+            rep_returns = (np.sum(per_rep, axis=0).tolist()
+                           if per_rep else None)
+            if rep_returns is not None:
+                for r, v in enumerate(rep_returns):
+                    hub.gauge("replica_return", v, replica=str(r))
+            if buffers is not None and hasattr(buffers, "size"):
+                for r, fill in enumerate(np.asarray(buffers.size).tolist()):
+                    hub.gauge("replica_replay_fill", fill, replica=str(r))
+            hub.event("harness_episode", episode=global_ep,
+                      episodic_return=returns[-1],
+                      mean_succ_ratio=succ[-1],
+                      final_succ_ratio=final_succ[-1],
+                      per_replica_return=rep_returns)
         if on_episode is not None:
             on_episode(ep, returns[-1], succ[-1], metrics)
     return state, buffers, returns, succ, final_succ

@@ -9,6 +9,7 @@ are one orbax checkpoint, so training resumes bit-exactly.
 """
 from __future__ import annotations
 
+import inspect
 import os
 from typing import Any, Optional
 
@@ -17,6 +18,15 @@ import orbax.checkpoint as ocp
 
 from ..agents.buffer import ReplayBuffer
 from ..agents.ddpg import DDPGState
+
+# ``partial_restore=`` landed in orbax well after the version this image
+# bakes in (0.7.0 rejects it with a TypeError) — gate on the actual
+# signature rather than a version string so forward/backward installs both
+# work.  Older orbax spells the same semantics through the transformations
+# API: ``transforms={}`` + ``transforms_default_to_original`` restores
+# exactly the keys present in ``item`` and drops extra on-disk entries.
+_PARTIAL_RESTORE_KWARG = "partial_restore" in inspect.signature(
+    ocp.args.PyTreeRestore.__init__).parameters
 
 
 def save_checkpoint(path: str, state: DDPGState,
@@ -53,12 +63,14 @@ def load_checkpoint(path: str, example_state: DDPGState,
         target["extra"] = example_extra
     if partial:
         ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-        return ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(
-                item=target,
-                restore_args=ocp.checkpoint_utils.construct_restore_args(
-                    target),
-                partial_restore=True))
+        kwargs = dict(
+            item=target,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(target))
+        if _PARTIAL_RESTORE_KWARG:
+            args = ocp.args.PyTreeRestore(partial_restore=True, **kwargs)
+        else:
+            args = ocp.args.PyTreeRestore(transforms={}, **kwargs)
+        return ckptr.restore(path, args=args)
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, target)
 

@@ -62,16 +62,31 @@ class PhaseTimer:
 
 class TestModeWriter:
     """CSV suite with the reference's file names and headers
-    (writer.py:26-110)."""
+    (writer.py:26-110).
+
+    ``flush_every`` batches the every-file flush to one in every N
+    ``write_step`` calls (default 1 = the reference's flush-per-interval
+    behavior, which the parity tests rely on; long evaluation sweeps pass
+    ``Trainer.evaluate(telemetry_flush_every=N)`` so 8 file flushes stop
+    gating every control interval).
+    ``close`` always flushes whatever is buffered and is idempotent; the
+    writer is also a context manager (``with TestModeWriter(...) as w:``).
+    """
 
     def __init__(self, test_dir: str, write_schedule: bool = False,
                  write_flow_actions: bool = False,
-                 sf_names: Sequence[str] = (), sfc_names: Sequence[str] = ()):
+                 sf_names: Sequence[str] = (), sfc_names: Sequence[str] = (),
+                 flush_every: int = 1):
+        if flush_every < 1:
+            raise ValueError(f"flush_every must be >= 1, got {flush_every}")
         os.makedirs(test_dir, exist_ok=True)
         self.sf_names = list(sf_names)
         self.sfc_names = list(sfc_names)
         self.write_schedule = write_schedule
         self.write_flow_actions = write_flow_actions
+        self.flush_every = flush_every
+        self._steps_since_flush = 0
+        self._closed = False
         self._files = {}
         self._writers = {}
 
@@ -122,7 +137,8 @@ class TestModeWriter:
             self._writers["flow_actions.csv"].writerow(
                 [episode, time, flow_id, rem_ttl, ttl, cur_node, dest_node,
                  cur_node_rem_cap, next_node_rem_cap, link_cap, link_rem_cap])
-            self._files["flow_actions.csv"].flush()
+            if self.flush_every == 1:
+                self._files["flow_actions.csv"].flush()
 
     def write_step(self, episode: int, time: float, metrics, placement,
                    node_cap, node_names: Optional[Sequence[str]] = None,
@@ -181,9 +197,24 @@ class TestModeWriter:
                                 rows.append([episode, time, names[src],
                                              sfcs[c], sfs[s], names[dst], p])
             self._writers["scheduling.csv"].writerows(rows)
-        for f in self._files.values():
-            f.flush()
+        self._steps_since_flush += 1
+        if self._steps_since_flush >= self.flush_every:
+            self._steps_since_flush = 0
+            for f in self._files.values():
+                f.flush()
 
     def close(self):
+        """Flush and close every file; safe to call more than once (and
+        called automatically when used as a context manager)."""
+        if self._closed:
+            return
+        self._closed = True
         for f in self._files.values():
-            f.close()
+            f.close()   # close() flushes Python-buffered data itself
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,390 @@
+"""Observability subsystem tests: MetricsHub semantics, JSONL event schema
+stability, atomic snapshots, watchdog stall detection (hung fake
+prefetcher + a real stalled train run), and the end-to-end tiny train run
+the acceptance bar specifies.
+
+All marked ``obs`` — `pytest -m obs -q` is the standalone smoke group.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gsc_tpu.obs import (
+    ListSink,
+    MetricsHub,
+    PipelineWatchdog,
+    RunObserver,
+    write_atomic_json,
+)
+from tests.test_agent import make_driver, make_stack
+
+pytestmark = pytest.mark.obs
+
+# the stable per-episode event contract — tools/obs_report.py, the README
+# schema table and external tail tooling all read these names
+EPISODE_EVENT_KEYS = {
+    "event", "ts", "run", "episode", "global_step", "sps",
+    "episodic_return", "mean_succ_ratio", "critic_loss", "actor_loss",
+    "critic_grad_norm", "actor_grad_norm", "drop_reasons",
+    "truncated_arrivals", "replay_bytes", "phases", "device_memory",
+}
+
+
+# -------------------------------------------------------------------- hub
+def test_hub_counter_gauge_histogram_semantics():
+    hub = MetricsHub(tags={"run": "t"})
+    assert hub.counter("eps") == 1.0
+    assert hub.counter("eps", 2.0) == 3.0
+    assert hub.get_counter("eps") == 3.0
+    # tags address distinct series
+    hub.counter("drops", 5, reason="TTL")
+    hub.counter("drops", 1, reason="NODE_CAP")
+    assert hub.get_counter("drops", reason="TTL") == 5.0
+    assert hub.get_counter("drops") == 0.0
+
+    hub.gauge("sps", 10.0)
+    hub.gauge("sps", 12.5)   # last write wins
+    assert hub.get_gauge("sps") == 12.5
+
+    for v in range(100):
+        hub.observe("phase_s", v / 100.0, phase="drain")
+    s = hub.histogram_summary("phase_s", phase="drain")
+    assert s["count"] == 100
+    assert s["min"] == 0.0 and s["max"] == 0.99
+    assert abs(s["p50"] - 0.5) < 0.05
+    assert abs(s["p99"] - 0.99) < 0.05
+    assert abs(s["mean"] - 0.495) < 1e-6
+
+
+def test_hub_snapshot_prometheus_flat_names():
+    hub = MetricsHub(tags={"run": "r1"})
+    hub.counter("episodes_drained", 3)
+    hub.gauge("sps", 99.0)
+    hub.observe("phase_s", 0.5, phase="dispatch")
+    snap = hub.snapshot()
+    assert snap['gsc_episodes_drained{run="r1"}'] == 3.0
+    assert snap['gsc_sps{run="r1"}'] == 99.0
+    assert snap['gsc_phase_s_p50{phase="dispatch",run="r1"}'] == 0.5
+    assert snap['gsc_phase_s_count{phase="dispatch",run="r1"}'] == 1.0
+
+
+def test_hub_thread_safety_under_concurrent_writers():
+    hub = MetricsHub()
+    n, k = 8, 200
+
+    def spam():
+        for _ in range(k):
+            hub.counter("c")
+            hub.observe("h", 1.0)
+            hub.beat("t")
+
+    threads = [threading.Thread(target=spam) for _ in range(n)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert hub.get_counter("c") == n * k
+    assert hub.histogram_summary("h")["count"] == n * k
+
+
+def test_event_records_include_base_tags_and_reach_all_sinks():
+    hub = MetricsHub(tags={"run": "r2"})
+    a, b = ListSink(), ListSink()
+    hub.add_sink(a)
+    hub.add_sink(b)
+    rec = hub.event("stall", age_s=1.0)
+    assert rec["run"] == "r2" and rec["event"] == "stall"
+    assert a.records == b.records == [
+        {"event": "stall", "ts": rec["ts"], "run": "r2", "age_s": 1.0}]
+
+
+def test_atomic_snapshot_write(tmp_path):
+    path = str(tmp_path / "metrics.json")
+    write_atomic_json(path, {"a": 1})
+    write_atomic_json(path, {"a": 2, "np": np.float32(3.5)})
+    data = json.load(open(path))
+    assert data == {"a": 2, "np": 3.5}
+    # no temp droppings left behind
+    assert os.listdir(tmp_path) == ["metrics.json"]
+
+
+# --------------------------------------------------------------- watchdog
+class HungPrefetcher:
+    """A prefetcher whose producer died mid-run: queue stuck non-empty,
+    thread gone."""
+    queue_depth = 2
+
+    def is_alive(self):
+        return False
+
+
+def test_watchdog_flags_stall_with_hung_prefetcher():
+    hub = MetricsHub(tags={"run": "wd"})
+    sink = ListSink()
+    hub.add_sink(sink)
+    hub.counter("episodes_dispatched", 4)
+    hub.counter("episodes_drained", 3)
+    hub.note_phase("dispatch", done=False)
+    wd = PipelineWatchdog(hub, budget_s=0.15, poll_s=0.03)
+    pf = HungPrefetcher()
+    wd.register_probe("prefetch_queue_depth", lambda: pf.queue_depth)
+    wd.register_probe("prefetcher_alive", pf.is_alive)
+    wd.start()
+    try:
+        deadline = time.time() + 5.0
+        while not sink.of_kind("stall") and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    stalls = sink.of_kind("stall")
+    assert stalls, "watchdog never emitted a stall event"
+    s = stalls[0]
+    assert s["age_s"] > 0.15 and s["budget_s"] == 0.15
+    assert s["last_phase"] == "dispatch"
+    assert s["last_phase_state"] == "running"
+    assert s["dispatch_drain_lag"] == 1.0
+    assert s["prefetch_queue_depth"] == 2
+    assert s["prefetcher_alive"] is False
+    # one event per stall occurrence, not one per poll tick
+    assert len(stalls) == 1
+    assert hub.get_counter("stalls") == 1.0
+
+
+def test_watchdog_stays_quiet_while_heartbeats_flow():
+    hub = MetricsHub()
+    sink = ListSink()
+    hub.add_sink(sink)
+    wd = PipelineWatchdog(hub, budget_s=0.2, poll_s=0.03).start()
+    try:
+        for _ in range(10):
+            hub.beat("episode")
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert sink.of_kind("stall") == []
+
+
+def test_watchdog_paused_time_never_counts():
+    hub = MetricsHub()
+    sink = ListSink()
+    hub.add_sink(sink)
+    wd = PipelineWatchdog(hub, budget_s=0.1, poll_s=0.03, start_paused=True)
+    wd.start()
+    try:
+        time.sleep(0.3)          # paused: silence
+        assert sink.of_kind("stall") == []
+        wd.resume()              # resume beats, so the clock restarts
+        time.sleep(0.25)         # now a genuine stall
+    finally:
+        wd.stop()
+    assert len(sink.of_kind("stall")) == 1
+
+
+# ------------------------------------------------------------- end-to-end
+def _train_with_obs(tmp_path, episodes=3, watchdog_budget_s=0.0):
+    from gsc_tpu.agents import Trainer
+
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path / "obs"), run_id="e2e",
+                      snapshot_interval=2,
+                      watchdog_budget_s=watchdog_budget_s)
+    obs.start(meta={"episodes": episodes})
+    trainer = Trainer(env, driver, agent, seed=0,
+                      result_dir=str(tmp_path), obs=obs)
+    state, _ = trainer.train(episodes=episodes)
+    trainer.evaluate(state, episodes=1)
+    obs.close()
+    events = [json.loads(line)
+              for line in open(tmp_path / "obs" / "events.jsonl")]
+    return events, tmp_path / "obs"
+
+
+def test_end_to_end_train_run_event_schema(tmp_path):
+    """3 pipelined episodes: events.jsonl parses, every episode event
+    carries SPS / phase timings / losses / drop reasons / device memory /
+    replay bytes, metrics.json is a valid snapshot, and obs_report
+    summarizes the run without error."""
+    events, obs_dir = _train_with_obs(tmp_path, episodes=3)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    episodes = [e for e in events if e["event"] == "episode"]
+    assert [e["episode"] for e in episodes] == [0, 1, 2]
+    for ev in episodes:
+        assert EPISODE_EVENT_KEYS <= set(ev), \
+            EPISODE_EVENT_KEYS - set(ev)
+        assert ev["sps"] > 0
+        assert ev["run"] == "e2e"
+        assert ev["replay_bytes"] > 0
+        assert set(ev["drop_reasons"]) == {"TTL", "DECISION", "LINK_CAP",
+                                           "NODE_CAP"}
+        assert {"dispatch", "drain"} <= set(ev["phases"])
+        assert ev["phases"]["dispatch"]["total_s"] >= 0
+        assert len(ev["device_memory"]) >= 1
+        assert "device" in ev["device_memory"][0]
+    # pipelined run: the prefetch-wait phase appears (host_sample doesn't)
+    assert "host_sample_wait" in episodes[-1]["phases"]
+    assert [e for e in events if e["event"] == "eval_episode"]
+    assert not [e for e in events if e["event"] == "stall"]
+
+    snap = json.load(open(obs_dir / "metrics.json"))
+    assert snap["run"] == "e2e"
+    assert snap["metrics"]['gsc_episodes_drained{run="e2e"}'] == 3.0
+    assert snap["metrics"]['gsc_sps{run="e2e"}'] > 0
+
+    # the report tool renders this run and sees no flags
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import obs_report
+    summary = obs_report.summarize(obs_report.load_events(str(obs_dir)))
+    assert summary["episodes"] == 3
+    assert summary["stalls"] == []
+    assert summary["status"] == "ok"
+    obs_report.render_text(summary, out=open(os.devnull, "w"))
+
+
+def test_stalled_prefetcher_yields_stall_event_within_budget(tmp_path):
+    """Acceptance bar: a prefetcher that stops feeding episodes mid-run
+    produces a structured ``stall`` event within the watchdog budget —
+    while the trainer is still blocked inside ``prefetch.get``."""
+    from gsc_tpu.agents import Trainer
+    from gsc_tpu.env import EpisodeDriver
+
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path / "obs"), run_id="stall",
+                      watchdog_budget_s=0.25)
+    hub = obs.hub
+
+    class StallingDriver(EpisodeDriver):
+        # the producer thread hangs on episode 2's sampling — but only
+        # AFTER the consumer has drained episode 0, so the hang cannot
+        # hide inside the first dispatch's compile (the prefetcher runs
+        # ahead of the loop by design)
+        def traffic_for(self, episode, topo, seed=None):
+            if episode == 2:
+                deadline = time.time() + 60.0
+                while (hub.get_counter("episodes_drained") < 1
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                time.sleep(1.5)   # >> budget: the producer goes quiet
+            return EpisodeDriver.traffic_for(self, episode, topo, seed)
+
+    driver.__class__ = StallingDriver
+    obs.start()
+    trainer = Trainer(env, driver, agent, seed=0, obs=obs)
+    trainer.train(episodes=3)
+    obs.close()
+    events = [json.loads(line)
+              for line in open(tmp_path / "obs" / "events.jsonl")]
+    stalls = [e for e in events if e["event"] == "stall"]
+    assert stalls, "no stall event despite a 1.2s prefetch gap"
+    # a cold first-dispatch compile can trip an extra (legitimate) stall
+    # at this deliberately tiny budget — the prefetch stall must be among
+    # them, attributed to the phase the loop was actually stuck in
+    waits = [s for s in stalls if s["last_phase"] == "host_sample_wait"]
+    assert waits, [s["last_phase"] for s in stalls]
+    s = waits[0]
+    assert s["budget_s"] == 0.25
+    assert s["last_phase_state"] == "running"
+    assert s["prefetcher_alive"] is True
+    assert "prefetch_queue_depth" in s
+    # the run still completed: stall is a diagnostic, not a failure
+    kinds = [e["event"] for e in events]
+    assert kinds[-1] == "run_end"
+    assert len([e for e in events if e["event"] == "episode"]) == 3
+
+
+def test_invariant_violation_events(tmp_path):
+    """--check-invariants promotion: an overloaded flow table (truncated
+    arrivals) surfaces as a structured invariant_violation event."""
+    from gsc_tpu.agents import Trainer
+
+    env, agent, topo, traffic = make_stack(
+        sim_kwargs={"max_flows": 4, "inter_arrival_mean": 1.0})
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path), run_id="inv").start()
+    trainer = Trainer(env, driver, agent, seed=0, obs=obs,
+                      check_invariants=True)
+    trainer.train(episodes=1)
+    obs.close()
+    events = [json.loads(line) for line in open(tmp_path / "events.jsonl")]
+    violations = [e for e in events if e["event"] == "invariant_violation"]
+    assert violations and violations[0]["episode"] == 0
+    assert any("admitted late" in v for v in violations[0]["violations"])
+
+
+def test_cli_train_writes_event_stream(tmp_path):
+    """The default `cli train` surface produces a parseable events.jsonl +
+    metrics.json in the run's result dir (no obs flags passed)."""
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli as cli_group
+    from tests.test_agent import write_tiny_configs
+
+    args = write_tiny_configs(tmp_path)
+    r = CliRunner().invoke(cli_group, ["train", *args, "--episodes", "3",
+                                       "--result-dir",
+                                       str(tmp_path / "res")])
+    assert r.exit_code == 0, (r.output, r.exception)
+    rdir = json.loads(r.output.strip().splitlines()[-1])["result_dir"]
+    events = [json.loads(line)
+              for line in open(os.path.join(rdir, "events.jsonl"))]
+    episodes = [e for e in events if e["event"] == "episode"]
+    assert len(episodes) == 3
+    assert all("sps" in e and "phases" in e and "critic_loss" in e
+               for e in episodes)
+    assert events[-1]["event"] == "run_end"
+    assert events[-1]["status"] == "ok"
+    assert os.path.exists(os.path.join(rdir, "metrics.json"))
+
+
+def test_harness_per_replica_telemetry():
+    """run_chunked_episodes with a hub streams replica-tagged gauges and a
+    harness_episode event per episode."""
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.parallel.harness import run_chunked_episodes
+
+    import jax
+
+    env, agent, topo, traffic = make_stack()
+    B = 2
+    pddpg = ParallelDDPG(env, agent, num_replicas=B)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *([traffic] * B))
+    _, obs0 = pddpg.reset_all(jax.random.PRNGKey(0), topo, stacked)
+    one = jax.tree_util.tree_map(lambda x: x[0], obs0)
+    state = pddpg.init(jax.random.PRNGKey(1), one)
+    buffers = pddpg.init_buffers(one)
+
+    hub = MetricsHub(tags={"run": "par"})
+    sink = ListSink()
+    hub.add_sink(sink)
+    run_chunked_episodes(pddpg, topo, lambda ep: stacked, state, buffers,
+                         episodes=1, episode_steps=agent.episode_steps,
+                         chunk=agent.episode_steps // 2, seed=0, hub=hub)
+    evs = sink.of_kind("harness_episode")
+    assert len(evs) == 1
+    assert len(evs[0]["per_replica_return"]) == B
+    for r in range(B):
+        assert hub.get_gauge("replica_replay_fill", replica=str(r)) \
+            == agent.episode_steps
+        assert hub.get_gauge("replica_return", replica=str(r)) is not None
+
+
+def test_obs_report_selftest_smoke():
+    """The CI smoke target: tools/obs_report.py --selftest."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "obs_report.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "selftest: OK" in r.stdout

@@ -5,6 +5,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 from gsc_tpu.agents import Trainer
 from gsc_tpu.utils import load_checkpoint, save_checkpoint
@@ -74,6 +75,58 @@ def test_overload_surfaces_truncated_arrivals(tmp_path, caplog):
     with open(tmp_path / "test" / "metrics.csv") as f:
         rows = list(csv.reader(f))
     assert int(rows[-1][7]) > 0
+
+
+@pytest.mark.obs
+def test_testmode_writer_flush_every_and_close(tmp_path):
+    """flush_every batches the per-interval flush of all open CSVs;
+    close() always flushes the tail, is idempotent, and the writer works
+    as a context manager."""
+    import numpy as np_
+
+    from gsc_tpu.sim.state import SimMetrics
+    from gsc_tpu.utils.telemetry import TestModeWriter
+
+    metrics = SimMetrics.zeros(8, 1, 3, 8)
+    placement = np_.zeros((3, 3), np_.int32)
+    node_cap = np_.asarray([10.0, 10.0, 10.0])
+
+    def step(w, i):
+        w.write_step(episode=0, time=float(i), metrics=metrics,
+                     placement=placement, node_cap=node_cap)
+
+    def rows_on_disk(d):
+        # count data rows visible to a CONCURRENT reader (tail -f): only
+        # flushed bytes, so buffered rows don't count
+        with open(d / "metrics.csv") as f:
+            return max(len(f.read().strip().splitlines()) - 1, 0)
+
+    d1 = tmp_path / "batched"
+    w = TestModeWriter(str(d1), flush_every=3)
+    step(w, 0), step(w, 1)
+    assert rows_on_disk(d1) == 0      # nothing flushed yet
+    step(w, 2)
+    assert rows_on_disk(d1) == 3      # third call flushed the batch
+    step(w, 3)
+    w.close()
+    assert rows_on_disk(d1) == 4      # close() flushed the tail
+    w.close()                          # idempotent: no ValueError on
+    # double-close of the underlying files
+
+    # default keeps the reference's flush-per-interval behavior
+    d2 = tmp_path / "default"
+    w2 = TestModeWriter(str(d2))
+    step(w2, 0)
+    assert rows_on_disk(d2) == 1
+    w2.close()
+
+    d3 = tmp_path / "ctx"
+    with TestModeWriter(str(d3), flush_every=100) as w3:
+        step(w3, 0)
+    assert rows_on_disk(d3) == 1      # __exit__ closed (and so flushed)
+
+    with pytest.raises(ValueError):
+        TestModeWriter(str(tmp_path / "bad"), flush_every=0)
 
 
 def test_checkpoint_roundtrip(tmp_path):

@@ -1,0 +1,330 @@
+"""Render a run's ``events.jsonl`` into per-episode / per-phase summaries.
+
+Usage:
+    python tools/obs_report.py <run_dir | events.jsonl>  [--json]
+    python tools/obs_report.py --selftest
+
+Reads the event stream the ``gsc_tpu.obs`` subsystem writes (``cli train``
+does by default), prints:
+
+- a per-episode table: SPS, return, success ratio, learner losses, the
+  per-episode *delta* of each pipeline phase's host wall (the stream
+  carries cumulative ``PhaseTimer`` totals), and device bytes-in-use;
+- a final per-phase summary (total wall, mean ms per episode);
+- every ``stall`` / ``invariant_violation`` record, verbatim fields;
+- a device-memory growth check: bytes_in_use at the first vs last episode
+  per device, flagged when growth exceeds ``--mem-growth-threshold``
+  (a leaking HBM buffer shows as monotonic growth long before an OOM).
+
+``--json`` emits the same summary as one machine-readable JSON object.
+``--selftest`` synthesizes a stream (including a stall and a leak),
+renders it, and asserts both are flagged — the CI smoke target.
+
+Stdlib only: this must run on a login node with no JAX installed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+PHASES = ("host_sample", "host_sample_wait", "dispatch", "drain")
+# flag growth only past an absolute floor: allocator warmup on a small run
+# doubles tiny numbers without meaning anything
+MEM_FLOOR_BYTES = 16 * 2 ** 20
+
+
+def load_events(path: str) -> List[Dict]:
+    """Accept a run dir or the events.jsonl itself; skip torn tail lines
+    (the stream may still be appending)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        raise SystemExit(f"no events stream at {path}")
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue   # torn final line of a live run
+    return events
+
+
+def phase_deltas(episodes: List[Dict]) -> List[Dict[str, float]]:
+    """Per-episode phase seconds from the cumulative totals each episode
+    event carries."""
+    out, prev = [], {}
+    for ev in episodes:
+        totals = {name: info.get("total_s", 0.0)
+                  for name, info in (ev.get("phases") or {}).items()}
+        out.append({name: round(t - prev.get(name, 0.0), 4)
+                    for name, t in totals.items()})
+        prev = totals
+    return out
+
+
+def device_mem_series(episodes: List[Dict]) -> Dict[str, List[int]]:
+    """{device: [bytes_in_use per episode]} over devices that report."""
+    series: Dict[str, List[int]] = {}
+    for ev in episodes:
+        for rec in ev.get("device_memory") or []:
+            if "bytes_in_use" in rec:
+                series.setdefault(rec["device"], []).append(
+                    rec["bytes_in_use"])
+    return series
+
+
+def last_run(events: List[Dict]) -> List[Dict]:
+    """The JSONL sink appends, so a reused --obs-dir accumulates several
+    runs in one stream; summarize the LAST one (mixing runs would produce
+    negative phase deltas and interleaved episode numbers)."""
+    starts = [i for i, e in enumerate(events)
+              if e.get("event") == "run_start"]
+    return events[starts[-1]:] if starts else events
+
+
+def summarize(events: List[Dict], mem_growth_threshold: float = 0.2) -> Dict:
+    runs_in_stream = max(
+        sum(1 for e in events if e.get("event") == "run_start"), 1)
+    events = last_run(events)
+    episodes = [e for e in events if e.get("event") == "episode"]
+    stalls = [e for e in events if e.get("event") == "stall"]
+    violations = [e for e in events
+                  if e.get("event") == "invariant_violation"]
+    deltas = phase_deltas(episodes)
+
+    rows = []
+    for ev, d in zip(episodes, deltas):
+        mem = [r.get("bytes_in_use") for r in (ev.get("device_memory") or [])
+               if "bytes_in_use" in r]
+        rows.append({
+            "episode": ev.get("episode"),
+            "sps": ev.get("sps"),
+            "return": ev.get("episodic_return"),
+            "succ": ev.get("mean_succ_ratio"),
+            "critic_loss": ev.get("critic_loss"),
+            "actor_loss": ev.get("actor_loss"),
+            **{f"{p}_ms": round(1e3 * d.get(p, 0.0), 1) for p in PHASES
+               if p in d},
+            "trunc": ev.get("truncated_arrivals", 0),
+            "drops": sum((ev.get("drop_reasons") or {}).values()),
+            "mem_mb": round(sum(mem) / 2 ** 20, 1) if mem else None,
+        })
+
+    phase_summary = {}
+    if episodes:
+        final = episodes[-1].get("phases") or {}
+        for name, info in sorted(final.items()):
+            phase_summary[name] = {
+                "total_s": info.get("total_s"),
+                "count": info.get("count"),
+                "mean_ms": info.get("mean_ms"),
+            }
+
+    mem_flags = []
+    for device, series in device_mem_series(episodes).items():
+        if len(series) < 2:
+            continue
+        first, last = series[0], series[-1]
+        growth = (last - first) / max(first, 1)
+        if last - first > MEM_FLOOR_BYTES and growth > mem_growth_threshold:
+            mem_flags.append({
+                "device": device,
+                "first_bytes": first, "last_bytes": last,
+                "growth_pct": round(100 * growth, 1),
+            })
+
+    last_run_end = next((e for e in reversed(events)
+                         if e.get("event") == "run_end"), None)
+    return {
+        "episodes": len(episodes),
+        "run": episodes[0].get("run") if episodes else None,
+        "runs_in_stream": runs_in_stream,
+        "status": (last_run_end or {}).get("status"),
+        "rows": rows,
+        "phase_summary": phase_summary,
+        "stalls": stalls,
+        "invariant_violations": violations,
+        "memory_growth_flags": mem_flags,
+        "drop_totals": _drop_totals(episodes),
+    }
+
+
+def _drop_totals(episodes: List[Dict]) -> Dict[str, int]:
+    totals: Dict[str, int] = {}
+    for ev in episodes:
+        for reason, n in (ev.get("drop_reasons") or {}).items():
+            totals[reason] = totals.get(reason, 0) + int(n)
+    return totals
+
+
+def _fmt(v, width) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.3f}" if abs(v) < 1000 else f"{v:.0f}"
+    else:
+        s = str(v)
+    return s.rjust(width)
+
+
+def render_text(summary: Dict, out=sys.stdout):
+    w = out.write
+    w(f"run: {summary['run']}  episodes: {summary['episodes']}  "
+      f"status: {summary['status']}\n")
+    if summary.get("runs_in_stream", 1) > 1:
+        w(f"(stream holds {summary['runs_in_stream']} appended runs — "
+          "showing the last)\n")
+    rows = summary["rows"]
+    if rows:
+        w("(*_ms columns are phase-wall deltas between consecutive "
+          "episode events; on pipelined runs the deferred drain shifts "
+          "attribution one row — totals below are exact)\n")
+        cols = list(rows[0].keys())
+        widths = {c: max(len(c), 9) for c in cols}
+        w("  ".join(c.rjust(widths[c]) for c in cols) + "\n")
+        for r in rows:
+            w("  ".join(_fmt(r.get(c), widths[c]) for c in cols) + "\n")
+    w("\nper-phase host wall (cumulative):\n")
+    for name, info in summary["phase_summary"].items():
+        w(f"  {name:<18} total {info['total_s']:>9}s   "
+          f"count {info['count']:>5}   mean {info['mean_ms']:>8} ms\n")
+    if summary["drop_totals"]:
+        w("\nsim drop totals: "
+          + json.dumps(summary["drop_totals"]) + "\n")
+    if summary["stalls"]:
+        w(f"\n!! {len(summary['stalls'])} STALL(s):\n")
+        for s in summary["stalls"]:
+            w(f"  age {s.get('age_s')}s / budget {s.get('budget_s')}s — "
+              f"stuck in phase {s.get('last_phase')!r} "
+              f"({s.get('last_phase_state')}), dispatch-drain lag "
+              f"{s.get('dispatch_drain_lag')}, "
+              f"prefetch queue {s.get('prefetch_queue_depth', '-')}, "
+              f"prefetcher alive {s.get('prefetcher_alive', '-')}\n")
+    if summary["invariant_violations"]:
+        w(f"\n!! {len(summary['invariant_violations'])} INVARIANT "
+          "VIOLATION(s):\n")
+        for v in summary["invariant_violations"]:
+            w(f"  episode {v.get('episode')}: "
+              + "; ".join(v.get("violations", [])) + "\n")
+    if summary["memory_growth_flags"]:
+        w("\n!! DEVICE MEMORY GROWTH:\n")
+        for m in summary["memory_growth_flags"]:
+            w(f"  {m['device']}: {m['first_bytes']} -> {m['last_bytes']} "
+              f"bytes (+{m['growth_pct']}%)\n")
+    if not (summary["stalls"] or summary["invariant_violations"]
+            or summary["memory_growth_flags"]):
+        w("\nhealthy: no stalls, no invariant violations, no device "
+          "memory growth\n")
+
+
+# ------------------------------------------------------------------ selftest
+def _synthetic_events(path: str, episodes: int = 5):
+    """A stream with the real schema: growing cumulative phases, one stall,
+    leaking device memory."""
+    base = 1_000_000_000.0
+    with open(path, "w") as f:
+        def emit(rec):
+            f.write(json.dumps(rec) + "\n")
+
+        emit({"event": "run_start", "ts": base, "run": "selftest",
+              "episodes": episodes})
+        disp = drain = 0.0
+        for ep in range(episodes):
+            disp += 0.010
+            drain += 0.002
+            emit({"event": "episode", "ts": base + ep, "run": "selftest",
+                  "episode": ep, "global_step": 4 * ep + 3,
+                  "sps": 100.0 + ep, "episodic_return": -1.0 + 0.1 * ep,
+                  "mean_succ_ratio": 0.5, "critic_loss": 0.2,
+                  "actor_loss": -0.1, "q_values": 0.3,
+                  "drop_reasons": {"TTL": ep, "DECISION": 0,
+                                   "LINK_CAP": 0, "NODE_CAP": 1},
+                  "truncated_arrivals": 0, "replay_bytes": 4096,
+                  "phases": {
+                      "dispatch": {"total_s": round(disp, 4),
+                                   "count": ep + 1, "mean_ms": 10.0},
+                      "drain": {"total_s": round(drain, 4),
+                                "count": ep + 1, "mean_ms": 2.0}},
+                  # 64 MiB -> 64+96*ep MiB: well past floor + threshold
+                  "device_memory": [{
+                      "device": "FAKE_TPU_0", "available": True,
+                      "bytes_in_use": (64 + 96 * ep) * 2 ** 20,
+                      "peak_bytes_in_use": 256 * 2 ** 20,
+                      "bytes_limit": 16 * 2 ** 30}]})
+        emit({"event": "stall", "ts": base + episodes, "run": "selftest",
+              "age_s": 12.5, "budget_s": 10.0, "last_phase": "dispatch",
+              "last_phase_state": "running", "episodes_dispatched": 5,
+              "episodes_drained": 4, "dispatch_drain_lag": 1,
+              "heartbeats": {"episode": 12.5, "prefetcher": 0.2},
+              "prefetch_queue_depth": 2, "prefetcher_alive": True})
+        emit({"event": "invariant_violation", "ts": base + episodes,
+              "run": "selftest", "episode": 3,
+              "violations": ["negative node_load"]})
+        emit({"event": "run_end", "ts": base + episodes + 1,
+              "run": "selftest", "status": "ok", "episodes": episodes})
+
+
+def selftest() -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "events.jsonl")
+        _synthetic_events(path)
+        summary = summarize(load_events(path))
+        assert summary["episodes"] == 5, summary
+        assert len(summary["stalls"]) == 1, "stall not surfaced"
+        assert summary["stalls"][0]["last_phase"] == "dispatch"
+        assert len(summary["invariant_violations"]) == 1
+        assert summary["memory_growth_flags"], "memory growth not flagged"
+        assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
+        deltas = phase_deltas([e for e in last_run(load_events(path))
+                               if e.get("event") == "episode"])
+        assert abs(deltas[2]["dispatch"] - 0.010) < 1e-6, deltas[2]
+        render_text(summary)   # must not raise on a flagged stream
+        # append-mode reuse: a second run landing in the same stream must
+        # not corrupt the summary — the report partitions on run_start
+        body = open(path).read()
+        with open(path, "a") as f:
+            f.write(body)
+        s2 = summarize(load_events(path))
+        assert s2["runs_in_stream"] == 2 and s2["episodes"] == 5, s2
+        render_text(s2, out=open(os.devnull, "w"))
+    print("obs_report selftest: OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?",
+                    help="run directory or events.jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    ap.add_argument("--mem-growth-threshold", type=float, default=0.2,
+                    help="fractional bytes_in_use growth (first->last "
+                         "episode) flagged as a leak [default 0.2]")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthesize a stream and verify the report "
+                         "flags its stall/leak (CI smoke target)")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if not args.path:
+        ap.error("path required (or --selftest)")
+    summary = summarize(load_events(args.path),
+                        mem_growth_threshold=args.mem_growth_threshold)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        render_text(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
