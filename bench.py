@@ -4,8 +4,12 @@ Measures the full training loop — vmapped env-replica rollout (simulator
 physics + obs + reward on device) and the end-of-episode DDPG learn burst —
 on one chip, and prints ONE JSON line:
 
-    {"metric": "env_steps_per_sec_per_chip", "value": ..., "unit": ...,
-     "vs_baseline": ...}
+    {"metric": "env_steps_per_sec_per_chip", "status": "ok", "value": ...,
+     "unit": ..., "vs_baseline": ..., "pipeline": ..., "precision": ...}
+
+On failure (unreachable backend, every rung faulted) the line is instead
+``{"metric": ..., "status": "failed", "reason": ...}`` with NO ``value`` —
+readers must key on ``status``, never assume a number is present.
 
 Structure: a stdlib-only ORCHESTRATOR (this process) runs every JAX step in
 a child subprocess with a hard timeout, because a faulted TPU call wedges
@@ -25,6 +29,12 @@ one carrying the learn burst in the same program) and episode k's metric
 sync is deferred until after episode k+1's dispatch.  ``--pipeline off``
 (or GSC_BENCH_PIPELINE=0) restores the seed's two-call-per-episode shape
 so a pair of runs attributes the pipeline's share of the throughput.
+``--precision bf16`` (or GSC_BENCH_PRECISION) measures the mixed-precision
+policy (bf16 network compute + replay, f32 master state); every row
+records its ``precision`` so run-to-run comparisons attribute the dtype
+share.  A failed probe/run emits a structured ``{"status": "failed",
+"reason": ...}`` row — never a fake 0.0 measurement — so artifacts
+distinguish "slow" from "never ran".
 
 Baseline: the reference publishes no numbers (BASELINE.md); its training
 loop is a single SimPy env + torch DDPG on one CPU core
@@ -35,6 +45,7 @@ reference's own simulator step loop on this machine's CPU and stored in
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -93,6 +104,40 @@ def _pipeline_enabled() -> bool:
     loop; GSC_BENCH_PIPELINE=0 restores the two-call-per-episode path so a
     row can attribute the pipeline's share of the throughput."""
     return _env_int("GSC_BENCH_PIPELINE", 1) != 0
+
+
+def _precision() -> str:
+    """Dtype policy of the measured stack (config.schema.PRECISION_POLICIES):
+    'f32' (default; bit-identical to the dtype-unaware stack) or 'bf16'
+    (mixed-precision compute + replay, f32 master state).  Set by
+    ``--precision`` / GSC_BENCH_PRECISION; recorded in every row so a pair
+    of runs attributes the precision share of the throughput."""
+    prec = os.environ.get("GSC_BENCH_PRECISION", "f32").strip() or "f32"
+    if prec not in ("f32", "bf16"):
+        raise SystemExit(f"GSC_BENCH_PRECISION={prec!r} (expected f32|bf16)")
+    return prec
+
+
+def ladder():
+    """The (replicas, chunk, timeout) escalation ladder.  GSC_BENCH_LADDER
+    ("B,chunk,timeout[;B,chunk,timeout...]") overrides it — the CPU smoke
+    path (interpret-mode Pallas, 1-core CI boxes) needs a tiny rung, and a
+    lever-sweep winner can be measured without a code edit."""
+    raw = os.environ.get("GSC_BENCH_LADDER", "").strip()
+    if not raw:
+        return LADDER
+    rungs = []
+    for cell in raw.split(";"):
+        parts = [p.strip() for p in cell.split(",")]
+        if len(parts) != 3:
+            raise SystemExit(
+                f"GSC_BENCH_LADDER cell {cell!r} is not 'B,chunk,timeout'")
+        try:
+            rungs.append(tuple(int(p) for p in parts))
+        except ValueError:
+            raise SystemExit(f"GSC_BENCH_LADDER cell {cell!r} has a "
+                             "non-integer field")
+    return rungs
 
 
 def baseline_sps() -> float:
@@ -174,11 +219,17 @@ def orchestrate():
     t_start = time.time()   # budget includes probe time: the artifact JSON
                             # must print before any external driver deadline
     if not probe_with_retry():
+        # structured FAILED row, not a 0.0 "measurement": trajectory
+        # tooling reading BENCH_*.json must be able to distinguish "slow"
+        # from "never ran" (the round-5 wedged-tunnel failure mode banked
+        # a 0.0 that looked like a rate)
         print(json.dumps({
-            "metric": "env_steps_per_sec_per_chip", "value": 0.0,
-            "unit": "env-steps/s", "vs_baseline": 0.0,
-            "error": "TPU backend unreachable (init probe timed out after "
-                     f"{PROBE_RETRIES} attempts)"}))
+            "metric": "env_steps_per_sec_per_chip",
+            "status": "failed",
+            "reason": "TPU backend unreachable (init probe timed out after "
+                      f"{PROBE_RETRIES} attempts)",
+            "unit": "env-steps/s",
+            "pipeline": _pipeline_enabled(), "precision": _precision()}))
         sys.exit(1)
     best = None
     denom = baseline_sps()
@@ -186,6 +237,7 @@ def orchestrate():
     def artifact(b):
         return json.dumps({
             "metric": "env_steps_per_sec_per_chip",
+            "status": "ok",
             "value": b["value"],
             "unit": "env-steps/s",
             "vs_baseline": round(b["value"] / denom, 2),
@@ -197,6 +249,7 @@ def orchestrate():
             "baseline_sps": denom,
             "baseline_scope": "reference env-physics only (no torch agent)",
             "pipeline": b.get("pipeline", True),
+            "precision": b.get("precision", "f32"),
             # knobs come from the WORKER's banked row — derived from the
             # values it actually passed to its stack builder (ADVICE r5:
             # the old env-var echo tagged rung4/rung5/interroute rows with
@@ -212,7 +265,7 @@ def orchestrate():
     # — without it, three partial rungs would run ~2x the budget and the
     # driver would kill the process (rc != 0).
     grace_used = False
-    for replicas, chunk, timeout in LADDER:
+    for replicas, chunk, timeout in ladder():
         if time.time() - t_start + timeout > TOTAL_BUDGET_S:
             if best_clean or grace_used:
                 print("[bench] wall budget reached — stopping escalation",
@@ -243,10 +296,12 @@ def orchestrate():
                       "stopping", file=sys.stderr)
                 break
     if best is None:
+        # no fake 0.0 measurement — see the probe-failure row above
         print(json.dumps({
-            "metric": "env_steps_per_sec_per_chip", "value": 0.0,
-            "unit": "env-steps/s", "vs_baseline": 0.0,
-            "error": "all ladder rungs failed"}))
+            "metric": "env_steps_per_sec_per_chip",
+            "status": "failed", "reason": "all ladder rungs failed",
+            "unit": "env-steps/s",
+            "pipeline": _pipeline_enabled(), "precision": _precision()}))
         sys.exit(1)
     print(artifact(best))
 
@@ -271,8 +326,6 @@ def _interroute_stack(episode_steps):
     Note this is NOT BASELINE config 5 (200+-node synthetic + mixed SFC
     catalog, covered by tests/test_rung5.py) — it benchmarks the biggest
     network the reference actually ships."""
-    import dataclasses
-
     from __graft_entry__ import _flagship
     from gsc_tpu.topology.synthetic import interroute
 
@@ -365,6 +418,7 @@ def worker(replicas: int, chunk: int, episodes: int,
     knobs = {}
     pipeline = _pipeline_enabled()   # every row carries "pipeline" at top
     # level — not duplicated into knobs
+    precision = _precision()         # likewise "precision"
     if scenario in STACKS:
         env, agent, topo = STACKS[scenario](EPISODE_STEPS)
     else:
@@ -376,10 +430,13 @@ def worker(replicas: int, chunk: int, episodes: int,
             knobs["max_flows"] = mf
         env, agent, topo, _ = _flagship(
             episode_steps=EPISODE_STEPS, max_flows=mf, gen_traffic=False)
+    if precision != "f32":
+        # the dtype policy rides on the agent config, so every scenario's
+        # stack (flagship and hardcoded rungs alike) honors it — models,
+        # replay shards and the learn burst all read agent.precision
+        agent = dataclasses.replace(agent, precision=precision)
     unroll = _env_int("GSC_BENCH_SCAN_UNROLL", 0)
     if unroll:
-        import dataclasses
-
         from gsc_tpu.env.env import ServiceCoordEnv
         # scan_unroll rebuilds the env for EVERY scenario, so the knob
         # legitimately tags all rows
@@ -451,7 +508,7 @@ def worker(replicas: int, chunk: int, episodes: int,
             "value": round(sps, 1),
             "unit": "env-steps/s",
             "replicas": B, "chunk": chunk, "scenario": scenario,
-            "pipeline": pipeline,
+            "pipeline": pipeline, "precision": precision,
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),
@@ -506,6 +563,16 @@ if __name__ == "__main__":
         if mode not in ("on", "off"):
             raise SystemExit(f"--pipeline expects on|off, got {mode!r}")
         os.environ["GSC_BENCH_PIPELINE"] = "1" if mode == "on" else "0"
+        del argv[i:i + 2]
+    if "--precision" in argv:
+        # forwarded the same way so every rung measures one dtype policy;
+        # a missing value must ERROR — silently defaulting would bank a
+        # mislabeled f32 number for a user who meant to measure bf16
+        i = argv.index("--precision")
+        prec = argv[i + 1] if i + 1 < len(argv) else None
+        if prec not in ("f32", "bf16"):
+            raise SystemExit(f"--precision expects f32|bf16, got {prec!r}")
+        os.environ["GSC_BENCH_PRECISION"] = prec
         del argv[i:i + 2]
     if argv and argv[0] == "--worker":
         worker(int(argv[1]), int(argv[2]), int(argv[3]),

@@ -96,7 +96,14 @@ def buffer_nbytes(buf: ReplayBuffer) -> int:
     """Total replay storage footprint in bytes.  The buffer is the largest
     HBM resident of a training run; the pipeline telemetry logs this so the
     copy traffic that ``donate_argnums`` eliminates (one full-buffer copy
-    per episode on the non-donating path) is attributable."""
+    per episode on the non-donating path) is attributable.
+
+    Summed per leaf from the ACTUAL storage dtype (``l.dtype.itemsize``),
+    never from an assumed element size — under a mixed-dtype policy
+    (bf16 obs/action leaves next to f32 reward/done, PrecisionPolicy.
+    replay_dtype) the ``replay bytes`` gauge must reflect the halved
+    residency, not double-count bf16 leaves as f32
+    (tests/test_precision.py::test_buffer_nbytes_mixed_dtypes)."""
     return sum(l.size * l.dtype.itemsize
                for l in jax.tree_util.tree_leaves(buf.data))
 

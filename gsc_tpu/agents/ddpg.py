@@ -25,6 +25,15 @@ Faithful semantics:
 - Polyak tau = target_model_update each gradient step (simple_ddpg.py:229-234)
 - train once per episode end: episode_steps gradient steps on batches of
   batch_size (simple_ddpg.py:300-325)
+
+Precision (AgentConfig.precision -> PrecisionPolicy): learner state —
+params, Polyak targets, Adam moments, PRNG — is ALWAYS f32 master state;
+the bf16 policy only changes the networks' internal compute dtype (casts
+live inside actor/critic apply) and the replay STORAGE dtype of obs/action
+leaves (``example_transition``; ``buffer_add``'s write-side ``astype``
+then rounds rollout transitions once on insert).  Rewards, done flags,
+exploration noise, TD targets and the soft-update arithmetic never leave
+f32, so the reward scale and tau=1e-4 target updates are unaffected.
 """
 from __future__ import annotations
 
@@ -126,11 +135,24 @@ class DDPG:
         )
 
     def example_transition(self, sample_obs):
-        """Shape/dtype template of one replay transition."""
+        """Shape/dtype template of one replay transition.  Under a
+        low-precision replay policy the float leaves of obs/next_obs and
+        the action are stored in ``PrecisionPolicy.replay_dtype`` (halving
+        the largest HBM resident); reward and done stay f32 so TD-target
+        scale survives replay round-trips."""
+        rd = self.agent.precision_policy.replay_cast_dtype
+        obs, action = sample_obs, jnp.zeros(self.action_dim)
+        if rd is not None:
+            d = jnp.dtype(rd)
+            obs = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x).astype(d)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+                sample_obs)
+            action = action.astype(d)
         return {
-            "obs": sample_obs,
-            "next_obs": sample_obs,
-            "action": jnp.zeros(self.action_dim),
+            "obs": obs,
+            "next_obs": obs,
+            "action": action,
             "reward": jnp.zeros(()),
             "done": jnp.zeros(()),
         }

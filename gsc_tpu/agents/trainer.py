@@ -85,6 +85,11 @@ class Trainer:
         # replay/env-state are safe; pass donate=False for comparison
         # drivers that re-call kernels on the same inputs
         self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl, donate=donate)
+        if self.obs is not None:
+            # param/compute/replay dtype gauges + one precision event so
+            # run-to-run throughput comparisons can attribute speedups to
+            # the dtype policy (bench rows carry the same field)
+            self.obs.record_precision(agent_cfg.precision_policy)
         self.result_dir = result_dir
         # per-phase host wall timings of the last train() call
         # (utils.telemetry.PhaseTimer) — how much host time hid behind

@@ -141,13 +141,16 @@ def init_configs(out: str):
 
 
 def _build(agent_config, simulator_config, service, scheduler, seed,
-           max_nodes, max_edges, resource_functions_path=None):
+           max_nodes, max_edges, resource_functions_path=None,
+           precision=None):
     from .config.loader import load_agent, load_scheduler, load_service, load_sim
     from .config.schema import EnvLimits
     from .env.driver import EpisodeDriver
     from .env.env import ServiceCoordEnv
 
-    agent = load_agent(agent_config)
+    # --precision overrides the agent yaml's (or default f32) policy
+    agent = load_agent(agent_config,
+                       **({"precision": precision} if precision else {}))
     sim_cfg = load_sim(simulator_config)
     svc = load_service(service,
                        resource_functions_path=resource_functions_path)
@@ -199,6 +202,14 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "device step, deferred metric draining — bit-identical "
                    "results, the chip never idles between episodes; "
                    "--no-pipeline runs the serial reference loop")
+@click.option("--precision", type=click.Choice(["f32", "bf16"]),
+              default=None,
+              help="dtype policy override: f32 (default; bit-identical to "
+                   "the dtype-unaware stack) or bf16 (mixed-precision "
+                   "network compute + replay storage with f32 master "
+                   "params/optimizer/TD targets — ~2x MXU throughput, "
+                   "half the replay HBM).  Unset = the agent yaml's "
+                   "'precision' key (default f32)")
 @click.option("--obs/--no-obs", "obs_enabled", default=True,
               show_default=True,
               help="unified run telemetry: per-episode events.jsonl "
@@ -225,8 +236,8 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          pipeline, obs_enabled, obs_dir, obs_interval, watchdog_budget,
-          check_invariants, verbose):
+          pipeline, precision, obs_enabled, obs_dir, obs_interval,
+          watchdog_budget, check_invariants, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -250,6 +261,29 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
     outputs = {}
     for run in range(runs):
         run_seed = seed + run
+        if resume:
+            # the checkpoint records the precision it was trained under
+            # (sidecar meta): silently rebuilding its bf16 replay into an
+            # f32 template (or vice versa) would either round the buffer
+            # or drop it behind a misleading format-mismatch fallback —
+            # adopt the recorded policy, and refuse a contradicting flag
+            from .utils.checkpoint import read_checkpoint_meta
+            meta = read_checkpoint_meta(resume)
+            # a checkpoint without the sidecar predates the precision
+            # policy and can only hold f32 state/replay — treating it as
+            # anything else would rebuild a mismatched replay template
+            # and drop the stored buffer behind the format-fallback path
+            ck_prec = meta.get("precision") or "f32"
+            if precision and precision != ck_prec:
+                raise click.BadParameter(
+                    f"--precision {precision} contradicts the checkpoint's "
+                    f"{'recorded' if 'precision' in meta else 'implicit pre-meta'} "
+                    f"policy ({ck_prec}); resume adopts the checkpoint's "
+                    "precision — drop the flag or retrain")
+            if not precision and ck_prec != "f32":
+                click.echo(f"[resume] adopting checkpoint precision "
+                           f"{ck_prec}", err=True)
+            precision = ck_prec
         rdir = setup_result_dir(result_dir, experiment_id)
         run_dirs.append(rdir)
         copy_inputs(rdir, [agent_config, simulator_config, service, scheduler])
@@ -263,7 +297,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         setup_logging(verbose=False, logfile=os.path.join(rdir, "run.log"))
         env, driver, agent = _build(agent_config, simulator_config, service,
                                     scheduler, run_seed, max_nodes, max_edges,
-                                    resource_functions_path)
+                                    resource_functions_path,
+                                    precision=precision)
         obs = None
         if obs_enabled:
             from .obs import RunObserver
@@ -278,6 +313,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
+                            "precision": agent.precision,
                             "result_dir": rdir})
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
                           tensorboard=tensorboard, obs=obs,
@@ -344,7 +380,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
                                    buffer=buffer,
                                    extra={"episode": _np.asarray(episodes,
-                                                                 _np.int32)})
+                                                                 _np.int32)},
+                                   meta={"precision": agent.precision})
             result.runtime_start("test")
             test = trainer.evaluate(state, episodes=1, test_mode=True,
                                     telemetry=True)
@@ -383,18 +420,29 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
 @click.option("--max-edges", default=37, show_default=True)
 @click.option("--resource-functions-path", default=None,
               help="dir (or .py file) of user resource-function plugins")
+@click.option("--precision", type=click.Choice(["f32", "bf16"]),
+              default=None,
+              help="dtype policy override; unset = the checkpoint's "
+                   "recorded policy (sidecar meta; falls back to the "
+                   "agent yaml for pre-meta checkpoints) so the greedy "
+                   "episodes evaluate under the compute dtype the "
+                   "checkpoint was trained with")
 def infer(agent_config, simulator_config, service, scheduler, checkpoint,
-          episodes, seed, max_nodes, max_edges, resource_functions_path):
+          episodes, seed, max_nodes, max_edges, resource_functions_path,
+          precision):
     """Restore a checkpoint and run greedy test episodes
     (inference.py:17-40)."""
     from .agents.trainer import Trainer
-    from .utils.checkpoint import load_full_or_partial
+    from .utils.checkpoint import load_full_or_partial, read_checkpoint_meta
 
     import numpy as _np
 
+    if precision is None:
+        precision = read_checkpoint_meta(checkpoint).get("precision")
     env, driver, agent = _build(agent_config, simulator_config, service,
                                 scheduler, seed, max_nodes, max_edges,
-                                resource_functions_path)
+                                resource_functions_path,
+                                precision=precision)
     trainer = Trainer(env, driver, agent, seed=seed)
     topo, traffic = driver.episode(0, test_mode=True)
     _, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)

@@ -55,6 +55,101 @@ class FrozenMap(Mapping):
         return f"FrozenMap({dict(self._items)!r})"
 
 SUPPORTED_OBJECTIVES = ("prio-flow", "soft-deadline", "soft-deadline-exp", "weighted")
+# Dtypes a mixed-precision compute/replay slot may take.  float16 is
+# deliberately absent: bf16 shares f32's exponent range so the policy needs
+# no loss scaling — the property the whole PrecisionPolicy design leans on.
+_COMPUTE_DTYPES = ("float32", "bfloat16")
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """End-to-end dtype policy for the training stack.
+
+    The policy separates three concerns per module family:
+
+    - ``param_dtype``: the MASTER storage dtype of network parameters and
+      optimizer state.  Always float32 — Polyak target updates at
+      tau=1e-4 (AgentConfig.target_model_update) underflow to no-ops in
+      bf16's 8-bit mantissa, and Adam's second-moment EMA degrades the
+      same way, so masters never leave f32 (the Podracer/MindSpeed-RL
+      "mixed compute, full-precision state" recipe).
+    - ``gnn_compute`` / ``mlp_compute``: the activation/matmul dtype of
+      the GATv2 embedder and the actor/critic Dense stacks.  bf16 halves
+      the dominant [B, N, N, F] attention intermediate and runs the MXU
+      at ~2x f32 throughput; every contraction still ACCUMULATES in f32
+      via ``preferred_element_type`` and the attention softmax runs on
+      f32 logits.
+    - ``replay_dtype``: storage dtype of replay obs/action leaves
+      (agents/buffer.py) — halves the largest HBM resident.  Rewards and
+      done flags always stay f32 so TD-target scale survives.
+
+    Network OUTPUTS (actions, Q-values) are always f32: exploration
+    noise, TD targets and target-network soft updates run at full
+    precision regardless of the compute dtype.  ``f32`` everywhere is the
+    default and is bit-identical to a stack with no dtype policy at all
+    (the pre-policy code paths are taken verbatim when a slot is f32).
+    """
+
+    name: str = "f32"
+    param_dtype: str = "float32"
+    gnn_compute: str = "float32"
+    mlp_compute: str = "float32"
+    accum_dtype: str = "float32"
+    output_dtype: str = "float32"
+    replay_dtype: str = "float32"
+
+    def __post_init__(self):
+        for slot in ("param_dtype", "accum_dtype", "output_dtype"):
+            if getattr(self, slot) != "float32":
+                raise ValueError(
+                    f"{slot} must be float32 (f32 master params/accumulators"
+                    f"/outputs are the policy contract), got "
+                    f"{getattr(self, slot)!r}")
+        for slot in ("gnn_compute", "mlp_compute", "replay_dtype"):
+            if getattr(self, slot) not in _COMPUTE_DTYPES:
+                raise ValueError(
+                    f"{slot} must be one of {_COMPUTE_DTYPES}, got "
+                    f"{getattr(self, slot)!r}")
+
+    # -- consumers key on None = "take the legacy exact-f32 code path" --
+    @property
+    def gnn_dtype(self) -> Optional[str]:
+        return None if self.gnn_compute == "float32" else self.gnn_compute
+
+    @property
+    def mlp_dtype(self) -> Optional[str]:
+        return None if self.mlp_compute == "float32" else self.mlp_compute
+
+    @property
+    def replay_cast_dtype(self) -> Optional[str]:
+        return None if self.replay_dtype == "float32" else self.replay_dtype
+
+    @property
+    def mixed(self) -> bool:
+        return any(getattr(self, s) != "float32"
+                   for s in ("gnn_compute", "mlp_compute", "replay_dtype"))
+
+
+# Named policies selectable via AgentConfig.precision / `cli train
+# --precision` / `bench.py --precision`.  "f32" is bit-identical to the
+# pre-policy stack; "bf16" is the TPU mixed-precision recipe.
+PRECISION_POLICIES = {
+    "f32": PrecisionPolicy(name="f32"),
+    "bf16": PrecisionPolicy(name="bf16", gnn_compute="bfloat16",
+                            mlp_compute="bfloat16",
+                            replay_dtype="bfloat16"),
+}
+
+
+def precision_policy(name: str) -> PrecisionPolicy:
+    """Resolve a policy name (AgentConfig.precision) to its PrecisionPolicy."""
+    try:
+        return PRECISION_POLICIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {name!r} (expected one of "
+            f"{tuple(PRECISION_POLICIES)})") from None
+
 # Observation components supported by the env (reference:
 # src/rlsp/envs/simulator_wrapper.py:178-235 builds these three vectors).
 SUPPORTED_OBSERVATIONS = ("ingress_traffic", "node_load", "node_cap")
@@ -270,6 +365,12 @@ class AgentConfig:
     # action post-processing (reference: simple_ddpg.py:130-131)
     schedule_threshold: float = 0.1
 
+    # Precision policy name (PRECISION_POLICIES): "f32" (default,
+    # bit-identical to the dtype-unaware stack) or "bf16" (mixed-precision
+    # compute + replay with f32 master params/optimizer state).  New key —
+    # the reference is implicitly f32 end to end.
+    precision: str = "f32"
+
     def __post_init__(self):
         # the reference's agent_type dispatch (main.py:374-381) is broken
         # upstream (SAC_Agent is never defined); here unknown types fail fast
@@ -294,6 +395,15 @@ class AgentConfig:
             # 0 would silently run zero gradient steps per learn burst;
             # use None (= episode_steps) for the reference schedule
             raise ValueError("learn_steps must be >= 1 (or None)")
+        if self.precision not in PRECISION_POLICIES:
+            raise ValueError(
+                f"unknown precision {self.precision!r} (expected one of "
+                f"{tuple(PRECISION_POLICIES)})")
+
+    @property
+    def precision_policy(self) -> PrecisionPolicy:
+        """The resolved dtype policy (models/agents consume this)."""
+        return PRECISION_POLICIES[self.precision]
 
 
 @dataclass(frozen=True)

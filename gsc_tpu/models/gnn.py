@@ -19,21 +19,28 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops.gat import dense_adj, gatv2_dense, gatv2_segment
+from ..ops.gat import dense_adj, gatv2_dense, gatv2_segment, project
 
 
 class GATv2Conv(nn.Module):
     """One GATv2 layer (reference: torch_geometric GATv2Conv as used at
-    models.py:22-27).  ``impl``: 'dense' (default), 'segment' or 'pallas'."""
+    models.py:22-27).  ``impl``: 'dense' (default), 'segment' or 'pallas'.
+
+    ``compute_dtype`` (PrecisionPolicy.gnn_compute, e.g. "bfloat16") sets
+    the attention compute precision; parameters are always stored f32
+    (master copies) and cast at use, and ``None`` keeps the exact legacy
+    f32 path."""
 
     features: int
     mean_aggr: bool = True
     impl: str = "dense"
+    compute_dtype: str = None
 
     @nn.compact
     def __call__(self, x, adj=None, edge_index=None, edge_mask=None,
                  node_mask=None):
         f_in = x.shape[-1]
+        cd = self.compute_dtype
         glorot = nn.initializers.glorot_uniform()
         w_l = self.param("w_l", glorot, (f_in, self.features))
         b_l = self.param("b_l", nn.initializers.zeros, (self.features,))
@@ -43,23 +50,27 @@ class GATv2Conv(nn.Module):
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
         if self.impl == "segment":
             fn = lambda xi, ei, em, nm: gatv2_segment(
-                xi, ei, em, nm, w_l, b_l, w_r, b_r, att, bias, self.mean_aggr)
+                xi, ei, em, nm, w_l, b_l, w_r, b_r, att, bias,
+                self.mean_aggr, compute_dtype=cd)
             for _ in range(x.ndim - 2):
                 fn = jax.vmap(fn)
             return fn(x, edge_index, edge_mask, node_mask)
         if self.impl == "pallas":
             from ..ops.pallas_gat import gatv2_pallas
-            xl = x @ w_l + b_l
-            xr = x @ w_r + b_r
+            xl = project(x, w_l, b_l, cd)
+            xr = project(x, w_r, b_r, cd)
             return gatv2_pallas(xl, xr, att, bias, adj, self.mean_aggr)
         return gatv2_dense(x, adj, w_l, b_l, w_r, b_r, att, bias,
-                           self.mean_aggr)
+                           self.mean_aggr, compute_dtype=cd)
 
 
 def masked_mean_pool(x: jnp.ndarray, node_mask: jnp.ndarray) -> jnp.ndarray:
-    """global_mean_pool over real nodes (models.py:44, 53)."""
-    m = node_mask.astype(x.dtype)[..., None]
-    return (x * m).sum(axis=-2) / jnp.maximum(m.sum(axis=-2), 1.0)
+    """global_mean_pool over real nodes (models.py:44, 53).  The readout
+    reduction always ACCUMULATES in f32 (PrecisionPolicy accum contract) —
+    a no-op for f32 inputs, a widening cast for bf16 activations."""
+    xf = x.astype(jnp.float32)
+    m = node_mask.astype(xf.dtype)[..., None]
+    return (xf * m).sum(axis=-2) / jnp.maximum(m.sum(axis=-2), 1.0)
 
 
 class GNNEmbedder(nn.Module):
@@ -74,6 +85,7 @@ class GNNEmbedder(nn.Module):
     impl: str = "dense"
     pool: bool = True   # False: return per-node features at the readout
                         # point (factored action heads read node embeddings)
+    compute_dtype: str = None  # PrecisionPolicy.gnn_compute; None = f32
 
     @nn.compact
     def __call__(self, nodes, edge_index, edge_mask, node_mask):
@@ -83,7 +95,7 @@ class GNNEmbedder(nn.Module):
         kw = dict(adj=adj, edge_index=edge_index, edge_mask=edge_mask,
                   node_mask=node_mask)
         conv_args = dict(features=self.hidden, mean_aggr=self.mean_aggr,
-                         impl=self.impl)
+                         impl=self.impl, compute_dtype=self.compute_dtype)
 
         def readout(x):
             return masked_mean_pool(x, node_mask) if self.pool else x

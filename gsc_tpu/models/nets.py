@@ -12,11 +12,20 @@ MLP semantics follow torch_geometric.nn.MLP with norm=None, plain_last=True:
 Linear -> ReLU between layers, no activation after the last (so the actor's
 output is unbounded; the agent clips to the action box after adding noise,
 simple_ddpg.py:195-201).
+
+Mixed precision (AgentConfig.precision -> config.schema.PrecisionPolicy):
+the GNN embedder and the Dense stacks compute in the policy's compute
+dtype (params stay f32 masters, cast at use; matmuls accumulate f32 via
+``preferred_element_type``), and BOTH network outputs — actions and
+Q-values — are cast to f32 at the module boundary so exploration noise,
+TD targets and Polyak updates always run at full precision.  The "f32"
+policy takes the original code paths verbatim (bit-identical).
 """
 from __future__ import annotations
 
 from typing import Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -25,15 +34,35 @@ from ..env.observations import GraphObs
 from .gnn import GNNEmbedder, masked_mean_pool
 
 
+def _accum_f32_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                           preferred_element_type=None):
+    """Low-precision operands, f32 MXU accumulation, activation settled
+    back to the operand dtype (nn.Dense ``dot_general`` hook)."""
+    return jax.lax.dot_general(
+        lhs, rhs, dimension_numbers, precision=precision,
+        preferred_element_type=jnp.float32).astype(lhs.dtype)
+
+
+def _dense_kw(dtype: str | None) -> dict:
+    """nn.Dense kwargs for a compute dtype; {} = the exact legacy layer."""
+    if dtype is None:
+        return {}
+    return dict(dtype=jnp.dtype(dtype), dot_general=_accum_f32_dot_general)
+
+
 class MLP(nn.Module):
-    """Linear/ReLU stack, plain last layer (torch_geometric MLP, norm=None)."""
+    """Linear/ReLU stack, plain last layer (torch_geometric MLP, norm=None).
+    ``dtype`` is the compute dtype (PrecisionPolicy.mlp_compute); params
+    are stored f32 and cast at use, dots accumulate f32."""
 
     features: Tuple[int, ...]
+    dtype: str = None
 
     @nn.compact
     def __call__(self, x):
+        kw = _dense_kw(self.dtype)
         for i, f in enumerate(self.features):
-            x = nn.Dense(f)(x)
+            x = nn.Dense(f, **kw)(x)
             if i < len(self.features) - 1:
                 x = nn.relu(x)
         return x
@@ -44,7 +73,8 @@ def _embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
                        num_layers=agent.gnn_num_layers,
                        num_iter=agent.gnn_num_iter,
                        mean_aggr=agent.gnn_aggr == "mean",
-                       impl=impl)
+                       impl=impl,
+                       compute_dtype=agent.precision_policy.gnn_dtype)
 
 
 def _node_embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
@@ -52,7 +82,8 @@ def _node_embedder(agent: AgentConfig, impl: str) -> GNNEmbedder:
                        num_layers=agent.gnn_num_layers,
                        num_iter=agent.gnn_num_iter,
                        mean_aggr=agent.gnn_aggr == "mean",
-                       impl=impl, pool=False)
+                       impl=impl, pool=False,
+                       compute_dtype=agent.precision_policy.gnn_dtype)
 
 
 # action dims (N * C * S * N') above which the monolithic Dense output
@@ -106,9 +137,11 @@ class Actor(nn.Module):
 
     @nn.compact
     def __call__(self, obs):
+        mdt = self.agent.precision_policy.mlp_dtype
         if not self.agent.graph_mode:
-            return MLP(tuple(self.agent.actor_hidden_layer_nodes)
-                       + (self.action_dim,))(obs)
+            out = MLP(tuple(self.agent.actor_hidden_layer_nodes)
+                      + (self.action_dim,), dtype=mdt)(obs)
+            return out.astype(jnp.float32)
         assert isinstance(obs, GraphObs)
         if use_factored_head(self.agent, self.action_dim):
             n, c, s, n2 = _check_sched_shape(self.sched_shape,
@@ -119,24 +152,33 @@ class Actor(nn.Module):
             # per-src hidden through the configured actor stack (global
             # context broadcast onto every node)
             h = jnp.concatenate(
-                [feats, jnp.broadcast_to(pooled[..., None, :],
-                                         feats.shape[:-1] + pooled.shape[-1:])],
+                [feats, jnp.broadcast_to(
+                    pooled.astype(feats.dtype)[..., None, :],
+                    feats.shape[:-1] + pooled.shape[-1:])],
                 axis=-1)
-            h = MLP(tuple(self.agent.actor_hidden_layer_nodes))(h)
+            h = MLP(tuple(self.agent.actor_hidden_layer_nodes),
+                    dtype=mdt)(h)
             h = nn.relu(h)
             g = self.agent.factored_key_dim
-            q = nn.Dense(c * s * g, name="query")(h)      # [.., N, C*S*G]
-            k = nn.Dense(g, name="key")(feats)            # [.., N', G]
+            q = nn.Dense(c * s * g, name="query",
+                         **_dense_kw(mdt))(h)             # [.., N, C*S*G]
+            k = nn.Dense(g, name="key", **_dense_kw(mdt))(feats)  # [.., N', G]
             q = q.reshape(q.shape[:-2] + (n, c, s, g))
-            out = jnp.einsum("...ncsg,...mg->...ncsm", q, k)
+            if mdt is None:
+                out = jnp.einsum("...ncsg,...mg->...ncsm", q, k)
+            else:  # bilinear logits accumulate f32
+                out = jnp.einsum("...ncsg,...mg->...ncsm", q, k,
+                                 preferred_element_type=jnp.float32)
             out = out.reshape(out.shape[:-4] + (self.action_dim,))
         else:
             emb = _embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
-            h = jnp.concatenate([emb, obs.mask], axis=-1)
+            h = jnp.concatenate([emb, obs.mask.astype(emb.dtype)], axis=-1)
             out = MLP(tuple(self.agent.actor_hidden_layer_nodes)
-                      + (self.action_dim,))(h)
-        return out * obs.mask
+                      + (self.action_dim,), dtype=mdt)(h)
+        # actions leave the network in f32 regardless of compute dtype:
+        # noise, clipping and replay post-processing stay full precision
+        return (out * obs.mask).astype(jnp.float32)
 
 
 class QNetwork(nn.Module):
@@ -164,9 +206,12 @@ class QNetwork(nn.Module):
 
     @nn.compact
     def __call__(self, obs, action):
+        mdt = self.agent.precision_policy.mlp_dtype
         if not self.agent.graph_mode:
-            return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,))(
-                jnp.concatenate([obs, action], axis=-1))
+            out = MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,),
+                      dtype=mdt)(
+                jnp.concatenate([obs, action.astype(obs.dtype)], axis=-1))
+            return out.astype(jnp.float32)
         assert isinstance(obs, GraphObs)
         if use_factored_head(self.agent, action.shape[-1]):
             n, c, s, n2 = _check_sched_shape(self.sched_shape,
@@ -176,19 +221,29 @@ class QNetwork(nn.Module):
             pooled = masked_mean_pool(feats, obs.node_mask)
             g = self.agent.factored_key_dim
             a4 = action.reshape(action.shape[:-1] + (n, c, s, n2))
-            k = nn.Dense(g, name="key")(feats)            # [.., N', G]
-            a_enc = jnp.einsum("...ncsm,...mg->...ncsg", a4, k)
+            k = nn.Dense(g, name="key", **_dense_kw(mdt))(feats)  # [.., N', G]
+            if mdt is None:
+                a_enc = jnp.einsum("...ncsm,...mg->...ncsg", a4, k)
+            else:  # action contraction accumulates f32
+                a_enc = jnp.einsum("...ncsm,...mg->...ncsg",
+                                   a4.astype(jnp.dtype(mdt)), k,
+                                   preferred_element_type=jnp.float32)
             z = jnp.concatenate(
-                [feats, a_enc.reshape(a_enc.shape[:-3] + (c * s * g,))],
+                [feats, a_enc.reshape(a_enc.shape[:-3]
+                                      + (c * s * g,)).astype(feats.dtype)],
                 axis=-1)
-            z = nn.relu(nn.Dense(self.agent.gnn_features, name="src")(z))
+            z = nn.relu(nn.Dense(self.agent.gnn_features, name="src",
+                                 **_dense_kw(mdt))(z))
             z = masked_mean_pool(z, obs.node_mask)
             h = jnp.concatenate([pooled, z], axis=-1)
         else:
             emb = _embedder(self.agent, self.gnn_impl)(
                 obs.nodes, obs.edge_index, obs.edge_mask, obs.node_mask)
-            h = jnp.concatenate([emb, obs.mask, action], axis=-1)
-        return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,))(h)
+            h = jnp.concatenate([emb, obs.mask.astype(emb.dtype),
+                                 action.astype(emb.dtype)], axis=-1)
+        # Q-values leave in f32: TD targets and losses stay full precision
+        return MLP(tuple(self.agent.critic_hidden_layer_nodes) + (1,),
+                   dtype=mdt)(h).astype(jnp.float32)
 
 
 def scale_action(action: jnp.ndarray, low: float = 0.0,

@@ -91,6 +91,24 @@ class RunObserver:
         if self.watchdog is not None:
             self.watchdog.pause()
 
+    def record_precision(self, policy):
+        """Dtype-policy gauges + one ``precision`` event (policy is a
+        ``config.schema.PrecisionPolicy``).  Gauges carry the bit width per
+        role so ``metrics.json`` diffs show a dtype change numerically;
+        the event carries the dtype names for the per-run report header
+        (tools/obs_report.py)."""
+        bits = {"float32": 32, "bfloat16": 16, "float16": 16}
+        for role, dt in (("param", policy.param_dtype),
+                         ("gnn_compute", policy.gnn_compute),
+                         ("mlp_compute", policy.mlp_compute),
+                         ("replay", policy.replay_dtype)):
+            self.hub.gauge("dtype_bits", bits.get(dt, 0), role=role)
+        self.hub.event("precision", name=policy.name,
+                       param_dtype=policy.param_dtype,
+                       gnn_compute=policy.gnn_compute,
+                       mlp_compute=policy.mlp_compute,
+                       replay_dtype=policy.replay_dtype)
+
     def prefetcher_heartbeat(self):
         """Bound callable handed to ``EpisodeDriver.prefetcher`` — beats
         from the producer thread after every staged episode."""

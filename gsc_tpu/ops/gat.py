@@ -11,6 +11,15 @@ reference usage at src/rlsp/agents/models.py:22-27):
     e_ij   = a^T LeakyReLU_0.2(W_l x_j + W_r x_i)
     alpha  = softmax_j(e_ij) over in-neighbors (self-loop included)
     out_i  = aggr_j(alpha_ij * W_l x_j) + b      (aggr: sum or mean)
+
+Mixed precision (config.schema.PrecisionPolicy): every entry point takes a
+``compute_dtype`` — ``None`` runs the original float32 code VERBATIM
+(bit-identical to the dtype-unaware stack); ``"bfloat16"`` keeps the big
+pairwise [.., N, N, F] intermediate and the matmul operands in bf16 while
+the attention logits, softmax and all contraction ACCUMULATORS stay f32
+(``preferred_element_type``).  ``attention_dense`` keys the branch on its
+input dtype so the Pallas kernel's custom VJP (which differentiates through
+it) follows the forward's precision automatically.
 """
 from __future__ import annotations
 
@@ -19,6 +28,23 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 LEAKY_SLOPE = 0.2
+
+
+def project(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
+            compute_dtype: str | None = None) -> jnp.ndarray:
+    """``x @ w + b`` under the precision policy.  ``None``: the original
+    f32 expression, bit-identical.  Low precision: operands cast to the
+    compute dtype, the matmul accumulates f32 on the MXU
+    (``preferred_element_type``), and the activation settles back to the
+    compute dtype."""
+    if compute_dtype is None:
+        return x @ w + b
+    cd = jnp.dtype(compute_dtype)
+    xc = x.astype(cd)
+    y = jax.lax.dot_general(
+        xc, w.astype(cd), (((xc.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (y + b).astype(cd)
 
 
 def dense_adj(edge_index: jnp.ndarray, edge_mask: jnp.ndarray,
@@ -45,30 +71,58 @@ def attention_dense(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
     """The attention STAGE on already-projected features (xl/xr:
     [..., N, F]) — the math the Pallas kernel fuses, and the backward pass
     it borrows (pallas_gat.py defines the kernel's custom VJP through this
-    function)."""
-    e = xl[..., None, :, :] + xr[..., :, None, :]   # [..., i, j, F]
+    function).
+
+    Precision follows ``xl.dtype``: float32 inputs take the original code
+    path verbatim; low-precision inputs (bf16) keep the [.., i, j, F]
+    pairwise tensor and both matmul operand sets in that dtype with f32
+    logits/softmax/accumulators, and return in the input dtype — the same
+    op sequence the bf16 Pallas kernel fuses, so interpret-mode parity
+    holds bit-for-bit."""
+    if xl.dtype == jnp.float32:
+        e = xl[..., None, :, :] + xr[..., :, None, :]   # [..., i, j, F]
+        e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
+        logits = jnp.einsum("...ijf,f->...ij", e, att)
+        logits = jnp.where(adj, logits, NEG_INF)
+        mx = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
+        ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)
+        denom = ex.sum(axis=-1, keepdims=True)
+        alpha = ex / jnp.maximum(denom, 1e-30)
+        out = jnp.einsum("...ij,...jf->...if", alpha, xl)
+        if mean_aggr:
+            deg = adj.sum(axis=-1, keepdims=True)
+            out = out / jnp.maximum(deg, 1)
+        has_nbr = adj.any(axis=-1, keepdims=True)
+        return jnp.where(has_nbr, out + bias, 0.0)
+    cd = xl.dtype
+    e = xl[..., None, :, :] + xr[..., :, None, :]       # [..., i, j, F] bf16
     e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
-    logits = jnp.einsum("...ijf,f->...ij", e, att)
-    logits = jnp.where(adj, logits, NEG_INF)
+    logits = jnp.einsum("...ijf,f->...ij", e, att.astype(cd),
+                        preferred_element_type=jnp.float32)
+    logits = jnp.where(adj, logits, NEG_INF)            # f32 logits
     mx = jax.lax.stop_gradient(logits.max(axis=-1, keepdims=True))
-    ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)
+    ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)      # f32 softmax
     denom = ex.sum(axis=-1, keepdims=True)
-    alpha = ex / jnp.maximum(denom, 1e-30)
-    out = jnp.einsum("...ij,...jf->...if", alpha, xl)
+    alpha = (ex / jnp.maximum(denom, 1e-30)).astype(cd)
+    out = jnp.einsum("...ij,...jf->...if", alpha, xl,
+                     preferred_element_type=jnp.float32)
     if mean_aggr:
         deg = adj.sum(axis=-1, keepdims=True)
         out = out / jnp.maximum(deg, 1)
     has_nbr = adj.any(axis=-1, keepdims=True)
-    return jnp.where(has_nbr, out + bias, 0.0)
+    return jnp.where(has_nbr, out + bias, 0.0).astype(cd)
 
 
 def gatv2_dense(x: jnp.ndarray, adj: jnp.ndarray, w_l: jnp.ndarray,
                 b_l: jnp.ndarray, w_r: jnp.ndarray, b_r: jnp.ndarray,
                 att: jnp.ndarray, bias: jnp.ndarray,
-                mean_aggr: bool) -> jnp.ndarray:
-    """Dense masked GATv2 layer.  x: [..., N, F_in], adj: [..., N, N] bool."""
-    xl = x @ w_l + b_l                       # [..., N, F] source projection
-    xr = x @ w_r + b_r                       # [..., N, F] target projection
+                mean_aggr: bool,
+                compute_dtype: str | None = None) -> jnp.ndarray:
+    """Dense masked GATv2 layer.  x: [..., N, F_in], adj: [..., N, N] bool.
+    ``compute_dtype`` (PrecisionPolicy.gnn_compute) selects the attention
+    precision; None is the exact f32 path."""
+    xl = project(x, w_l, b_l, compute_dtype)  # [..., N, F] source projection
+    xr = project(x, w_r, b_r, compute_dtype)  # [..., N, F] target projection
     return attention_dense(xl, xr, att, bias, adj, mean_aggr)
 
 
@@ -76,13 +130,16 @@ def gatv2_segment(x: jnp.ndarray, edge_index: jnp.ndarray,
                   edge_mask: jnp.ndarray, node_mask: jnp.ndarray,
                   w_l: jnp.ndarray, b_l: jnp.ndarray, w_r: jnp.ndarray,
                   b_r: jnp.ndarray, att: jnp.ndarray, bias: jnp.ndarray,
-                  mean_aggr: bool) -> jnp.ndarray:
+                  mean_aggr: bool,
+                  compute_dtype: str | None = None) -> jnp.ndarray:
     """Edge-list segment-sum GATv2 (torch-geometric's sparse formulation),
     single graph: x [N, F_in], edge_index [2, E].  Self-loops appended for
-    real nodes."""
+    real nodes.  With ``compute_dtype`` the per-edge features stay in the
+    compute dtype while logits, softmax and the segment-sum aggregation
+    accumulate f32 (segment sums of a bf16*f32 product promote to f32)."""
     n = x.shape[0]
-    xl = x @ w_l + b_l
-    xr = x @ w_r + b_r
+    xl = project(x, w_l, b_l, compute_dtype)
+    xr = project(x, w_r, b_r, compute_dtype)
     loops = jnp.arange(n)
     # drop any self-loops already present, then append exactly one per real
     # node (torch-geometric removes and re-adds; the dense path dedups via
@@ -93,7 +150,12 @@ def gatv2_segment(x: jnp.ndarray, edge_index: jnp.ndarray,
                           node_mask])
     e = xl[src] + xr[dst]
     e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
-    logits = jnp.where(em, e @ att, NEG_INF)
+    if compute_dtype is None:
+        logits = jnp.where(em, e @ att, NEG_INF)
+    else:
+        logits = jnp.where(
+            em, jnp.einsum("ef,f->e", e, att.astype(e.dtype),
+                           preferred_element_type=jnp.float32), NEG_INF)
     seg_max = jax.ops.segment_max(logits, dst, num_segments=n)
     seg_max = jax.lax.stop_gradient(
         jnp.where(jnp.isfinite(seg_max), seg_max, 0.0))
@@ -102,7 +164,8 @@ def gatv2_segment(x: jnp.ndarray, edge_index: jnp.ndarray,
     alpha = ex / jnp.maximum(denom[dst], 1e-30)
     out = jax.ops.segment_sum(alpha[:, None] * xl[src], dst, num_segments=n)
     if mean_aggr:
-        deg = jax.ops.segment_sum(em.astype(x.dtype), dst, num_segments=n)
+        deg = jax.ops.segment_sum(em.astype(out.dtype), dst, num_segments=n)
         out = out / jnp.maximum(deg[:, None], 1)
     has_nbr = jax.ops.segment_max(em.astype(jnp.int32), dst, num_segments=n) > 0
-    return jnp.where(has_nbr[:, None], out + bias, 0.0)
+    out = jnp.where(has_nbr[:, None], out + bias, 0.0)
+    return out if compute_dtype is None else out.astype(compute_dtype)

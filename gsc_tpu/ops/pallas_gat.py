@@ -19,6 +19,17 @@ tile easily fits VMEM; TB trades VMEM for grid overhead.
 
 On CPU (tests, virtual meshes) the kernel runs in interpret mode and is
 bit-compared against ``gatv2_dense`` (tests/test_models.py).
+
+Mixed precision: the kernel is dtype-polymorphic over its xl/xr inputs.
+With bf16 projected features (PrecisionPolicy "bf16") the pairwise
+[TB, N, N, F] intermediate and both MXU operand sets live in bf16 —
+HALVING the VMEM per tile, so the default graph tile TB doubles — while
+the attention logits and the masked softmax accumulate in f32
+(``preferred_element_type`` on both contractions) and the result rounds
+once to bf16 at the output write.  Every cast is a no-op for f32 inputs,
+so the f32 kernel is unchanged.  The bf16 kernel is parity-tested against
+the bf16 branch of ``ops.gat.attention_dense`` in interpret mode
+(tests/test_precision.py).
 """
 from __future__ import annotations
 
@@ -39,36 +50,44 @@ def _gat_kernel(xl_ref, xr_ref, att_ref, bias_ref, adj_ref, out_ref, *,
     bias = bias_ref[...]      # [F]
     adj = adj_ref[...]        # [TB, N, N] bool
 
+    # dtype-polymorphic: every cast below is a no-op for f32 inputs; for
+    # bf16 the [TB, i, j, F] intermediate and both dot operand sets stay
+    # bf16 while logits/softmax/accumulators run f32 (preferred_element_
+    # type) — the same op sequence as attention_dense's bf16 branch
     e = xl[:, None, :, :] + xr[:, :, None, :]          # [TB, i, j, F]
     e = jnp.where(e >= 0, e, LEAKY_SLOPE * e)
     logits = jax.lax.dot_general(
-        e, att, (((3,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)            # [TB, i, j]
+        e, att.astype(e.dtype), (((3,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [TB, i, j] f32
     logits = jnp.where(adj, logits, NEG_INF)
     mx = logits.max(axis=-1, keepdims=True)
     ex = jnp.where(adj, jnp.exp(logits - mx), 0.0)
     denom = ex.sum(axis=-1, keepdims=True)
-    alpha = ex / jnp.maximum(denom, 1e-30)             # [TB, i, j]
+    alpha = (ex / jnp.maximum(denom, 1e-30)).astype(xl.dtype)  # [TB, i, j]
     out = jax.lax.dot_general(
         alpha, xl, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)            # [TB, i, F]
+        preferred_element_type=jnp.float32)            # [TB, i, F] f32
     if mean_aggr:
         deg = adj.sum(axis=-1, keepdims=True)
         out = out / jnp.maximum(deg, 1)
     has_nbr = adj.any(axis=-1, keepdims=True)
-    out_ref[...] = jnp.where(has_nbr, out + bias, 0.0)
+    out_ref[...] = jnp.where(has_nbr, out + bias, 0.0).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("mean_aggr", "tile_b", "interpret"))
 def _gatv2_pallas_impl(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
                        bias: jnp.ndarray, adj: jnp.ndarray,
-                       mean_aggr: bool = True, tile_b: int = 8,
+                       mean_aggr: bool = True, tile_b: int | None = None,
                        interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention stage.  xl/xr: [..., N, F] projected features,
     adj: [..., N, N] bool.  Leading dims are flattened into the graph batch;
-    a single graph (no leading dim) is supported too."""
+    a single graph (no leading dim) is supported too.  ``tile_b=None``
+    sizes the graph tile by the input dtype: 8 for f32, 16 for 2-byte
+    dtypes (the bf16 tile holds the same VMEM bytes as the f32 one)."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    if tile_b is None:
+        tile_b = 16 if jnp.dtype(xl.dtype).itemsize == 2 else 8
     lead = xl.shape[:-2]
     n, f = xl.shape[-2:]
     b = 1
@@ -104,7 +123,8 @@ def _gatv2_pallas_impl(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
                  bias: jnp.ndarray, adj: jnp.ndarray, mean_aggr: bool = True,
-                 tile_b: int = 8, interpret: bool | None = None) -> jnp.ndarray:
+                 tile_b: int | None = None,
+                 interpret: bool | None = None) -> jnp.ndarray:
     """Fused attention stage with a custom VJP.
 
     Pallas kernels define no autodiff rule, so without this the learn
@@ -114,7 +134,9 @@ def gatv2_pallas(xl: jnp.ndarray, xr: jnp.ndarray, att: jnp.ndarray,
     formulation (``ops.gat.attention_dense`` — the bit-parity reference
     this kernel is tested against), so gradients equal the dense path's
     exactly while the forward still skips the [B, N, N, F] HBM
-    intermediate."""
+    intermediate.  ``attention_dense`` keys its precision on the saved
+    residuals' dtype, so bf16 forwards get the matching bf16 backward
+    with f32 accumulation — no extra plumbing."""
     return _gatv2_pallas_impl(xl, xr, att, bias, adj, mean_aggr, tile_b,
                               interpret)
 

@@ -10,6 +10,14 @@ from the sharding annotations alone (no hand-written collectives).
 Replica semantics mirror the single-env agent exactly (same warmup schedule,
 noise, post-processing, episode-end learn burst); with B=1 this reduces to
 ``gsc_tpu.agents.DDPG``.
+
+Precision: the replicated learner state stays f32 master state under every
+policy (the inner DDPG owns that contract); ``init_buffers`` builds the
+replica shards from ``DDPG.example_transition``, so a bf16 replay policy
+halves EVERY shard and the cross-replica gathers of ``_sample_across`` /
+``_sample_local`` move half the bytes per batch.  The batch-mean gradient
+psum XLA inserts from the sharding annotations reduces f32 gradients — the
+compute dtype never leaks into the cross-chip reduction.
 """
 from __future__ import annotations
 

@@ -10,6 +10,7 @@ are one orbax checkpoint, so training resumes bit-exactly.
 from __future__ import annotations
 
 import inspect
+import json
 import os
 from typing import Any, Optional
 
@@ -29,10 +30,23 @@ _PARTIAL_RESTORE_KWARG = "partial_restore" in inspect.signature(
     ocp.args.PyTreeRestore.__init__).parameters
 
 
+def _meta_path(path: str) -> str:
+    # SIBLING of the orbax dir, not inside it: orbax owns (and rewrites)
+    # the checkpoint directory's contents on every force-save
+    return os.path.abspath(path).rstrip(os.sep) + ".meta.json"
+
+
 def save_checkpoint(path: str, state: DDPGState,
                     buffer: Optional[ReplayBuffer] = None,
-                    extra: Optional[dict] = None) -> str:
-    """Write learner state (+ optional replay buffer + metadata)."""
+                    extra: Optional[dict] = None,
+                    meta: Optional[dict] = None) -> str:
+    """Write learner state (+ optional replay buffer + metadata).
+
+    ``meta`` is plain-JSON run metadata (e.g. the precision policy name)
+    written to a ``<path>.meta.json`` sidecar — config-level facts a
+    resume/infer must know BEFORE it can build the restore templates, so
+    they cannot live inside the orbax pytree (whose restore already needs
+    correctly-dtyped examples)."""
     path = os.path.abspath(path)
     payload = {"state": state}
     if buffer is not None:
@@ -42,7 +56,30 @@ def save_checkpoint(path: str, state: DDPGState,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload, force=True)
     ckptr.wait_until_finished()
+    if meta is not None:
+        # atomic (temp + rename): a crash mid-write must never leave a
+        # truncated sidecar that reads back as "pre-meta f32" against a
+        # bf16 checkpoint
+        from ..obs.sinks import write_atomic_json
+        write_atomic_json(_meta_path(path), meta)
+    else:
+        # a meta-less re-save to the same path must not leave the PREVIOUS
+        # save's sidecar describing the new checkpoint
+        try:
+            os.unlink(_meta_path(path))
+        except OSError:
+            pass
     return path
+
+
+def read_checkpoint_meta(path: str) -> dict:
+    """The ``save_checkpoint(meta=...)`` sidecar; {} for checkpoints
+    written before the sidecar existed (implicitly f32, full-f32 replay)."""
+    try:
+        with open(_meta_path(path)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
 
 
 def load_checkpoint(path: str, example_state: DDPGState,

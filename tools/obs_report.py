@@ -7,6 +7,9 @@ Usage:
 Reads the event stream the ``gsc_tpu.obs`` subsystem writes (``cli train``
 does by default), prints:
 
+- a per-run header with the dtype policy (the ``precision`` event /
+  run_start meta: policy name plus param/gnn/mlp/replay dtypes) so a
+  throughput comparison across runs is attributable to precision;
 - a per-episode table: SPS, return, success ratio, learner losses, the
   per-episode *delta* of each pipeline phase's host wall (the stream
   carries cumulative ``PhaseTimer`` totals), and device bytes-in-use;
@@ -143,11 +146,26 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2) -> Dict:
 
     last_run_end = next((e for e in reversed(events)
                          if e.get("event") == "run_end"), None)
+    # dtype-policy header fields: the trainer emits one `precision` event
+    # per run (RunObserver.record_precision); run_start meta carries the
+    # policy name too — either suffices for the header
+    precision_ev = next((e for e in events
+                         if e.get("event") == "precision"), None)
+    run_start = next((e for e in events
+                      if e.get("event") == "run_start"), None)
+    precision = None
+    if precision_ev is not None:
+        precision = {k: precision_ev.get(k)
+                     for k in ("name", "param_dtype", "gnn_compute",
+                               "mlp_compute", "replay_dtype")}
+    elif run_start is not None and run_start.get("precision"):
+        precision = {"name": run_start["precision"]}
     return {
         "episodes": len(episodes),
         "run": episodes[0].get("run") if episodes else None,
         "runs_in_stream": runs_in_stream,
         "status": (last_run_end or {}).get("status"),
+        "precision": precision,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -179,6 +197,15 @@ def render_text(summary: Dict, out=sys.stdout):
     w = out.write
     w(f"run: {summary['run']}  episodes: {summary['episodes']}  "
       f"status: {summary['status']}\n")
+    prec = summary.get("precision")
+    if prec:
+        detail = ""
+        if prec.get("param_dtype"):
+            detail = (f"  (param {prec['param_dtype']} / gnn "
+                      f"{prec.get('gnn_compute')} / mlp "
+                      f"{prec.get('mlp_compute')} / replay "
+                      f"{prec.get('replay_dtype')})")
+        w(f"precision: {prec.get('name')}{detail}\n")
     if summary.get("runs_in_stream", 1) > 1:
         w(f"(stream holds {summary['runs_in_stream']} appended runs — "
           "showing the last)\n")
@@ -235,7 +262,12 @@ def _synthetic_events(path: str, episodes: int = 5):
             f.write(json.dumps(rec) + "\n")
 
         emit({"event": "run_start", "ts": base, "run": "selftest",
-              "episodes": episodes})
+              "episodes": episodes, "precision": "bf16"})
+        # the dtype-gauge event the trainer emits via record_precision
+        emit({"event": "precision", "ts": base, "run": "selftest",
+              "name": "bf16", "param_dtype": "float32",
+              "gnn_compute": "bfloat16", "mlp_compute": "bfloat16",
+              "replay_dtype": "bfloat16"})
         disp = drain = 0.0
         for ep in range(episodes):
             disp += 0.010
@@ -278,6 +310,10 @@ def selftest() -> int:
         _synthetic_events(path)
         summary = summarize(load_events(path))
         assert summary["episodes"] == 5, summary
+        assert summary["precision"] == {
+            "name": "bf16", "param_dtype": "float32",
+            "gnn_compute": "bfloat16", "mlp_compute": "bfloat16",
+            "replay_dtype": "bfloat16"}, "precision header not surfaced"
         assert len(summary["stalls"]) == 1, "stall not surfaced"
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1
