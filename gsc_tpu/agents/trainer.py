@@ -29,9 +29,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config.schema import AgentConfig
+from ..config.schema import DROP_REASONS, AgentConfig
 from ..env.driver import EpisodeDriver
 from ..env.env import ServiceCoordEnv
+from ..obs.trace import episode_span, phase_span
+from ..utils.debug import check_invariants
+from ..utils.telemetry import PhaseTimer
+from .buffer import buffer_nbytes
 from .ddpg import DDPG, DDPGState
 
 log = logging.getLogger("gsc_tpu.agents.trainer")
@@ -134,7 +138,6 @@ class Trainer:
         head, so the ``np.asarray`` syncs here wait on device work that has
         already been followed by the next episode's dispatch — the chip
         never idles on host-side logging."""
-        from ..obs.trace import phase_span
         ep, end_step, stats, learn_metrics, trunc_dev, sim, topo, \
             replay_bytes = entry
         hub = self.obs.hub if self.obs else None
@@ -172,17 +175,19 @@ class Trainer:
         if self.check_invariants:
             # promoted from utils.debug: per drained episode, the final
             # sim state is checked host-side and violations become
-            # structured events rather than a silently-returned list
-            from ..utils.debug import check_invariants
+            # structured events rather than a silently-returned list.
+            # (check_invariants is a module-level import — a per-episode
+            # lazy import here cost an import-system round-trip inside
+            # the drain path, flagged by gsc-lint's hot-loop review.)
             errs = check_invariants(sim, topo, self.env.tables.chain_len)
             if errs:
                 log.warning("episode=%d simulator invariants violated: %s",
                             ep, "; ".join(errs))
                 if self.obs:
-                    self.obs.hub.event("invariant_violation", episode=ep,
-                                       violations=errs)
+                    # routed through the sentinel event pathway (counter +
+                    # structured event), same family as `compile` events
+                    self.obs.invariant_violation(ep, errs)
         if self.obs:
-            from ..config.schema import DROP_REASONS
             row = self.history[-1]
             self.obs.episode_end(
                 episode=ep, global_step=end_step,
@@ -227,9 +232,6 @@ class Trainer:
                                   init_buffer=init_buffer,
                                   start_episode=start_episode,
                                   pipeline=pipeline)
-        from ..obs.trace import episode_span, phase_span
-        from ..utils.telemetry import PhaseTimer
-        from .buffer import buffer_nbytes
         self.phase_timer = timer = PhaseTimer()
         hub = self.obs.hub if self.obs else None
         base = jax.random.PRNGKey(self.seed)
@@ -449,8 +451,6 @@ class Trainer:
             return samplers[id(topo)].sample_batch(
                 jax.random.fold_in(base, 2000 + ep), num_replicas)
 
-        from ..utils.telemetry import PhaseTimer
-        from .buffer import buffer_nbytes
         self.phase_timer = timer = PhaseTimer()
         hub = self.obs.hub if self.obs else None
         if self.obs:

@@ -1,0 +1,40 @@
+"""Static analysis + runtime sentinels for the jit discipline.
+
+Three generations of hand-won invariants — donation safety (PR 1), the
+telemetry contracts (PR 2), the precision-policy dtype discipline (PR 3)
+— are enforced here mechanically:
+
+- :mod:`~gsc_tpu.analysis.astlint` — the AST linter behind
+  ``tools/gsc_lint.py`` (rules R1–R5: host syncs in traced code,
+  use-after-donation, impure trace-time state, missing
+  ``preferred_element_type`` in bf16-policy modules, weak-type scalar
+  args at jitted entry points).
+- :mod:`~gsc_tpu.analysis.baseline` — the suppression baseline that
+  encodes accepted pre-existing cases (each with a written reason), so
+  CI fails only on NEW findings.
+- :mod:`~gsc_tpu.analysis.sentinels` — the runtime side:
+  :class:`CompileMonitor` (per-entry-point trace/compile counting, wired
+  into ``events.jsonl`` as ``compile`` events), ``assert_no_retrace``
+  and ``no_host_sync`` guards used by ``pytest -m analysis`` tests to
+  prove the pipelined episode loop compiles once and performs zero
+  unplanned device->host syncs in steady state.
+
+The linter is stdlib-only (``ast``); jax is imported lazily by the
+sentinels so ``tools/gsc_lint.py`` runs on a login node without device
+init.
+"""
+from .astlint import DONATED_SIGS, lint_files, lint_paths
+from .baseline import (apply_baseline, inline_suppression, load_baseline,
+                       save_baseline)
+from .findings import RULE_IDS, RULE_TITLES, Finding, LintResult
+from .sentinels import (DEFAULT_WATCH, CompileMonitor, HostSyncError,
+                        RetraceError, assert_no_retrace, no_host_sync)
+
+__all__ = [
+    "DONATED_SIGS", "lint_files", "lint_paths",
+    "apply_baseline", "inline_suppression", "load_baseline",
+    "save_baseline",
+    "RULE_IDS", "RULE_TITLES", "Finding", "LintResult",
+    "DEFAULT_WATCH", "CompileMonitor", "HostSyncError", "RetraceError",
+    "assert_no_retrace", "no_host_sync",
+]

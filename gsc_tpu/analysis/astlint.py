@@ -1,0 +1,691 @@
+"""gsc-lint: repo-specific JAX static analysis (stdlib ``ast`` only).
+
+Five rules encode the invariants three generations of perf PRs bought:
+
+- **R1 host-sync-in-jit** — ``.item()``, ``float()``/``int()`` on
+  non-literals, ``np.asarray``/``np.array``, ``block_until_ready``,
+  ``jax.device_get`` inside functions reachable from jitted/scanned code.
+  A host round-trip inside the fused ``episode_step`` path serializes the
+  pipeline (Podracer's throughput argument, PAPERS.md).
+- **R2 use-after-donation** — a variable passed in a donated argument
+  position of a known donating entry point (``donated_jit`` table) and
+  read again before being rebound: the PR 1 bug class (donated buffers
+  are CONSUMED; XLA may have reused the memory).
+- **R3 impure-in-jit** — ``time.time()``, Python/NumPy RNG, ``datetime``
+  and ``global`` mutation inside traced code: baked in at trace time,
+  silently frozen thereafter.
+- **R4 accum-dtype** — dot/einsum/matmul in the bf16-policy modules
+  (``ops/``, ``models/``) without ``preferred_element_type``: under the
+  bf16 policy the MXU would accumulate in bf16 (the PR 3 contract is f32
+  accumulation everywhere).  Calls lexically inside an f32-gated branch
+  (``if <x>.dtype == jnp.float32:`` / ``if <dtype-ish> is None:`` bodies)
+  are exempt — that is the repo's dtype-gate idiom for the verbatim
+  legacy path.
+- **R5 weak-scalar-arg** — numeric Python literals / scalar arithmetic
+  passed positionally to a known jitted entry point: weak-typed scalars
+  retrace on dtype flips (the trainer wraps with ``np.int32`` for this
+  reason).  Known STATIC positions (``num_steps``, ``learn``) are exempt.
+
+Tracing reachability is a deliberate over-approximation: jit roots are
+functions decorated with jit/pmap/etc., functions passed to
+``jax.jit``/``donated_jit``/``lax.scan``-family wrappers, and flax module
+``__call__``/``setup`` bodies; edges resolve callees by bare name against
+the project index (no type inference).  False positives land once in the
+suppression baseline with a written reason (see baseline.py); false
+negatives are bounded by the runtime sentinels (sentinels.py), which
+check the same properties dynamically.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, LintResult
+from .baseline import build_result, load_baseline
+
+# ----------------------------------------------------------- configuration
+
+# decorators / higher-order wrappers whose function arguments run traced
+_WRAPPER_ATTRS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "scan",
+    "fori_loop", "while_loop", "cond", "switch", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "defvjp", "shard_map", "pallas_call",
+    "associative_scan", "map",
+}
+# bare names accepted as wrappers without a jax/lax/nn prefix
+_WRAPPER_NAMES = {"jit", "donated_jit", "vmap", "pmap", "shard_map"}
+_WRAPPER_PREFIXES = {"jax", "lax", "nn", "pl", "pallas", "functools",
+                     "partial", "flax"}
+
+# donating entry points (donated_jit call sites in agents/ddpg.py and
+# parallel/dp.py): method name -> (donated call-site positional indices
+# with `self` already bound, donated parameter names, static positional
+# indices exempt from R5)
+DONATED_SIGS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...],
+                              Tuple[int, ...]]] = {
+    "episode_step": ((0, 1, 2), ("state", "buffer", "env_state"), (7, 8)),
+    "rollout_episode": ((1, 2), ("buffer", "env_state"), (7,)),
+    "learn_burst": ((0,), ("state",), ()),
+    "chunk_step": ((0, 1), ("state", "buffers"), (7, 8)),
+    "rollout_episodes": ((1,), ("buffers",), (7,)),
+}
+
+# which argument positions of a tracing wrapper are FUNCTIONS (passing a
+# loop bound or carry by name must not mark that name as jit-traced);
+# None = every positional arg (jit, vmap, grad, ... take only functions
+# up front)
+_WRAPPER_FN_ARGS: Dict[str, Optional[Tuple[int, ...]]] = {
+    "scan": (0,), "fori_loop": (2,), "while_loop": (0, 1),
+    "cond": (1, 2, 3), "switch": (1, 2, 3, 4, 5, 6, 7, 8),
+    "associative_scan": (0,), "pallas_call": (0,), "donated_jit": (1,),
+    "map": (0,),
+}
+_WRAPPER_FN_KWARGS = {"f", "fun", "body_fun", "cond_fun", "body", "kernel",
+                      "true_fun", "false_fun", "method"}
+
+# non-donating jitted entry points with STATIC positional args exempt from
+# R5 (jit static_argnums by design take plain Python values)
+STATIC_ARG_POSITIONS: Dict[str, Tuple[int, ...]] = {
+    # DeviceTraffic.sample_batch: num_replicas is static_argnums=1 at
+    # every jit site (tools/quality_anchor.py:220)
+    "sample_batch": (1,),
+}
+
+_HOST_SYNC_METHOD_ATTRS = {"item"}   # zero-arg array methods
+
+_NUMPY_NAMES = {"np", "numpy", "onp"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+_DOT_ATTRS = {"einsum", "dot", "matmul", "dot_general", "tensordot"}
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+               "perf_counter_ns", "time_ns"}
+_DATETIME_ATTRS = {"now", "utcnow", "today"}
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    """['jax', 'lax', 'scan'] for jax.lax.scan; None for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _trailing_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_wrapper_ref(node: ast.AST) -> bool:
+    """Does this expression reference a tracing wrapper (jax.jit,
+    donated_jit, lax.scan, ...)?"""
+    d = _dotted(node)
+    if not d:
+        return False
+    if len(d) == 1:
+        return d[0] in _WRAPPER_NAMES
+    return d[-1] in _WRAPPER_ATTRS and d[0] in _WRAPPER_PREFIXES
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    """True for @jax.jit, @partial(jax.jit, ...), @donated-style wrappers."""
+    for node in ast.walk(dec):
+        if isinstance(node, (ast.Attribute, ast.Name)) \
+                and _is_wrapper_ref(node):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ index
+
+@dataclass
+class FunctionInfo:
+    path: str                 # repo-relative posix path
+    qualname: str
+    name: str                 # bare name
+    node: ast.AST             # FunctionDef / AsyncFunctionDef
+    parent: Optional[str]     # enclosing function qualname (nested defs)
+    is_root: bool = False
+    callees: Set[str] = field(default_factory=set)   # bare callee names
+
+
+@dataclass
+class ModuleIndex:
+    path: str
+    tree: ast.Module
+    lines: List[str]
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # bare names referenced as function arguments of tracing wrappers
+    jit_refs: Set[str] = field(default_factory=set)
+    # class name -> is it (heuristically) a flax module
+    flax_classes: Set[str] = field(default_factory=set)
+
+
+def _collect_callees(fn_node: ast.AST) -> Set[str]:
+    """Bare names of everything called in the body (nested defs skipped —
+    they are indexed separately and linked via parent edges)."""
+    out: Set[str] = set()
+    for node in _walk_own(fn_node):
+        if isinstance(node, ast.Call):
+            # a tracing-wrapper call (jax.lax.scan(...)) is not an edge to
+            # local functions that happen to be named scan/cond/map — its
+            # FUNCTION arguments are collected into jit_refs instead
+            if _is_wrapper_ref(node.func):
+                continue
+            name = _trailing_name(node.func)
+            if name and not _is_at_indexed_update(node.func):
+                out.add(name)
+    return out
+
+
+def _is_at_indexed_update(func: ast.AST) -> bool:
+    """``x.at[idx].add(...)`` — jnp scatter methods, not call edges to
+    project functions that happen to be named add/set/max/min."""
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Subscript)
+            and isinstance(func.value.value, ast.Attribute)
+            and func.value.value.attr == "at")
+
+
+def _walk_own(fn_node: ast.AST):
+    """ast.walk over a function body EXCLUDING nested def/class subtrees
+    (each nested def gets its own FunctionInfo).  Lambda bodies are
+    INCLUDED: lambdas never get their own FunctionInfo, so a host sync
+    inside ``lax.cond(p, lambda v: v.item(), ...)`` belongs to the
+    enclosing function's scan."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def index_module(path: str, source: str) -> ModuleIndex:
+    tree = ast.parse(source, filename=path)
+    idx = ModuleIndex(path=path, tree=tree,
+                      lines=source.splitlines())
+
+    def visit(node, qual_stack: Tuple[str, ...], parent_fn: Optional[str],
+              in_flax: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                bases = " ".join(
+                    ".".join(_dotted(b) or ["?"]) for b in child.bases)
+                is_flax = ("Module" in bases or "nn." in bases
+                           or "linen" in bases or "struct" in bases)
+                if is_flax:
+                    idx.flax_classes.add(child.name)
+                visit(child, qual_stack + (child.name,), parent_fn, is_flax)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = ".".join(qual_stack + (child.name,))
+                info = FunctionInfo(
+                    path=path, qualname=qual, name=child.name,
+                    node=child, parent=parent_fn,
+                    callees=_collect_callees(child))
+                if any(_decorator_is_jit(d) for d in child.decorator_list):
+                    info.is_root = True
+                # flax module bodies always run under a trace
+                if in_flax and child.name in ("__call__", "setup"):
+                    info.is_root = True
+                idx.functions[qual] = info
+                visit(child, qual_stack + (child.name,), qual, in_flax)
+            else:
+                visit(child, qual_stack, parent_fn, in_flax)
+
+    visit(tree, (), None, False)
+
+    # function names handed to tracing wrappers anywhere in the module,
+    # restricted to the wrapper's FUNCTION argument positions (a loop
+    # bound passed to fori_loop by name is not a traced function)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_wrapper_ref(node.func):
+            wrapper = _trailing_name(node.func)
+            fn_pos = _WRAPPER_FN_ARGS.get(wrapper, None)
+            for i, arg in enumerate(node.args):
+                if fn_pos is not None and i not in fn_pos:
+                    continue
+                name = _trailing_name(arg)
+                if name:
+                    idx.jit_refs.add(name)
+            for kw in node.keywords:
+                if kw.arg in _WRAPPER_FN_KWARGS:
+                    name = _trailing_name(kw.value)
+                    if name:
+                        idx.jit_refs.add(name)
+    return idx
+
+
+# ------------------------------------------------------------ reachability
+
+def traced_functions(modules: Sequence[ModuleIndex]) -> Set[Tuple[str, str]]:
+    """(path, qualname) of every function reachable from a jit root via
+    bare-name call edges + nested-def parent edges."""
+    by_name: Dict[str, List[FunctionInfo]] = {}
+    all_fns: Dict[Tuple[str, str], FunctionInfo] = {}
+    jit_refs: Set[str] = set()
+    for m in modules:
+        jit_refs |= m.jit_refs
+        for info in m.functions.values():
+            by_name.setdefault(info.name, []).append(info)
+            all_fns[(m.path, info.qualname)] = info
+
+    work: List[FunctionInfo] = []
+    for info in all_fns.values():
+        if info.is_root or info.name in jit_refs:
+            work.append(info)
+    traced: Set[Tuple[str, str]] = set()
+    while work:
+        info = work.pop()
+        key = (info.path, info.qualname)
+        if key in traced:
+            continue
+        traced.add(key)
+        # call edges (bare-name resolution, project-wide)
+        for callee in info.callees:
+            for target in by_name.get(callee, ()):
+                if (target.path, target.qualname) not in traced:
+                    work.append(target)
+        # nested defs inherit the parent's traced status
+        prefix = info.qualname + "."
+        for other in all_fns.values():
+            if other.path == info.path \
+                    and other.qualname.startswith(prefix) \
+                    and (other.path, other.qualname) not in traced:
+                work.append(other)
+    return traced
+
+
+# ------------------------------------------------------------------ rules
+
+class _RuleContext:
+    def __init__(self, module: ModuleIndex, info: FunctionInfo,
+                 findings: List[Finding]):
+        self.module = module
+        self.info = info
+        self.findings = findings
+
+    def add(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 1)
+        text = ""
+        if 1 <= line <= len(self.module.lines):
+            text = self.module.lines[line - 1].strip()
+        self.findings.append(Finding(
+            rule=rule, path=self.module.path, line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            symbol=self.info.qualname, message=message, line_text=text))
+
+
+def _check_r1_r3(ctx: _RuleContext):
+    """Host-sync (R1) and impurity (R3) checks over a traced body."""
+    for node in _walk_own(ctx.info.node):
+        if isinstance(node, ast.Global):
+            ctx.add("R3", node,
+                    "`global` mutation inside jit-traced code is baked in "
+                    "at trace time")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        d = _dotted(f)
+        if isinstance(f, ast.Attribute):
+            # block_until_ready has two spellings — the array method
+            # x.block_until_ready() AND the module fn
+            # jax.block_until_ready(tree); both are host syncs
+            if f.attr == "block_until_ready":
+                ctx.add("R1", node,
+                        "block_until_ready forces a device->host sync "
+                        "inside traced code")
+            elif f.attr in _HOST_SYNC_METHOD_ATTRS and not node.args:
+                ctx.add("R1", node,
+                        f".{f.attr}() forces a device->host sync inside "
+                        "traced code")
+            elif f.attr in ("asarray", "array") and d \
+                    and d[0] in _NUMPY_NAMES:
+                ctx.add("R1", node,
+                        f"{'.'.join(d)}() materializes a host array "
+                        "inside traced code (use jnp)")
+            elif f.attr == "device_get" and d and d[0] == "jax":
+                ctx.add("R1", node,
+                        "jax.device_get() syncs device->host inside "
+                        "traced code")
+            # R3: wall clocks, host RNG, datetime
+            if d:
+                if d[0] == "time" and f.attr in _TIME_ATTRS:
+                    ctx.add("R3", node,
+                            f"time.{f.attr}() reads the host clock at "
+                            "trace time (frozen into the program)")
+                elif len(d) >= 3 and d[0] in _NUMPY_NAMES \
+                        and d[1] == "random":
+                    ctx.add("R3", node,
+                            f"{'.'.join(d)}() draws host RNG at trace "
+                            "time (use jax.random with a threaded key)")
+                elif d[0] == "random" and len(d) == 2:
+                    ctx.add("R3", node,
+                            f"random.{f.attr}() draws Python RNG at "
+                            "trace time (use jax.random)")
+                elif d[0] == "datetime" and f.attr in _DATETIME_ATTRS:
+                    ctx.add("R3", node,
+                            f"datetime.{f.attr}() reads the host clock "
+                            "at trace time")
+        elif isinstance(f, ast.Name):
+            if f.id in _CAST_BUILTINS and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                ctx.add("R1", node,
+                        f"{f.id}(...) on a non-literal forces a "
+                        "device->host sync when the value is traced")
+
+
+def _check_r2(ctx: _RuleContext):
+    """Use-after-donation: linear scan with a twice-unrolled loop pass so
+    a donation at the tail of an iteration is seen by the head of the
+    next.  If/else branches are scanned sequentially on shared state (an
+    over-approximation; exclusive-branch false positives go to the
+    baseline)."""
+    reported: Set[Tuple[int, str]] = set()
+
+    def names_loaded(node: ast.AST, skip: Set[int]) -> List[ast.Name]:
+        out = []
+        for n in ast.walk(node):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                out.append(n)
+        return out
+
+    def bound_names(targets: Iterable[ast.AST]) -> Set[str]:
+        out: Set[str] = set()
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and isinstance(
+                        n.ctx, (ast.Store, ast.Del)):
+                    out.add(n.id)
+        return out
+
+    def donations(st: ast.AST) -> List[Tuple[ast.Call, str, Set[str]]]:
+        out = []
+        for n in ast.walk(st):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = _trailing_name(n.func)
+            sig = DONATED_SIGS.get(callee or "")
+            if sig is None:
+                continue
+            positions, kw_names, _static = sig
+            donated: Set[str] = set()
+            for i, arg in enumerate(n.args):
+                if i in positions and isinstance(arg, ast.Name):
+                    donated.add(arg.id)
+            for kw in n.keywords:
+                if kw.arg in kw_names and isinstance(kw.value, ast.Name):
+                    donated.add(kw.value.id)
+            if donated:
+                out.append((n, callee, donated))
+        return out
+
+    consumed: Dict[str, Tuple[str, int]] = {}
+
+    def process(st: ast.AST):
+        # 1) reads of consumed names (anywhere in the statement)
+        for name in names_loaded(st, skip=set()):
+            hit = consumed.get(name.id)
+            if hit is not None:
+                callee, dline = hit
+                key = (name.lineno, name.id)
+                if key not in reported:
+                    reported.add(key)
+                    ctx.add("R2", name,
+                            f"`{name.id}` used after being donated to "
+                            f"{callee}() at line {dline} — donated "
+                            "buffers are consumed; rebind from the "
+                            "call's return")
+                consumed.pop(name.id, None)
+        # 2) donation effects, then rebinding
+        for call, callee, donated in donations(st):
+            for nm in donated:
+                consumed[nm] = (callee, call.lineno)
+        for nm in _stmt_bound(st):
+            consumed.pop(nm, None)
+
+    def _stmt_bound(st: ast.AST) -> Set[str]:
+        if isinstance(st, ast.Assign):
+            return bound_names(st.targets)
+        if isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            return bound_names([st.target])
+        if isinstance(st, ast.Delete):
+            return bound_names(st.targets)
+        return set()
+
+    def walk_body(stmts: Sequence[ast.AST]):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                process_expr_only(st.iter)
+                for nm in bound_names([st.target]):
+                    consumed.pop(nm, None)
+                for _ in range(2):      # expose cross-iteration reuse
+                    walk_body(st.body)
+                walk_body(st.orelse)
+            elif isinstance(st, ast.While):
+                process_expr_only(st.test)
+                for _ in range(2):
+                    walk_body(st.body)
+                walk_body(st.orelse)
+            elif isinstance(st, ast.If):
+                process_expr_only(st.test)
+                walk_body(st.body)
+                walk_body(st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    process_expr_only(item.context_expr)
+                    if item.optional_vars is not None:
+                        for nm in bound_names([item.optional_vars]):
+                            consumed.pop(nm, None)
+                walk_body(st.body)
+            elif isinstance(st, ast.Try):
+                walk_body(st.body)
+                for h in st.handlers:
+                    walk_body(h.body)
+                walk_body(st.orelse)
+                walk_body(st.finalbody)
+            else:
+                process(st)
+
+    def process_expr_only(expr: ast.AST):
+        if expr is not None:
+            process(expr)
+
+    walk_body(getattr(ctx.info.node, "body", []))
+
+
+def _is_f32_gate(test: ast.AST) -> bool:
+    """The repo's dtype-gate idiom: ``<x>.dtype == jnp.float32`` or
+    ``<dtype-ish name> is None`` (compute_dtype / mdt / cd...)."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return False
+    op = test.ops[0]
+    if isinstance(op, ast.Eq):
+        for side in (test.left, test.comparators[0]):
+            d = _dotted(side)
+            if d and d[-1] == "float32":
+                return True
+        return False
+    if isinstance(op, ast.Is) and isinstance(test.comparators[0],
+                                             ast.Constant) \
+            and test.comparators[0].value is None:
+        d = _dotted(test.left)
+        if d:
+            last = d[-1].lower()
+            return "dt" in last or "dtype" in last
+    return False
+
+
+def _check_r4(ctx: _RuleContext):
+    """Missing preferred_element_type on MXU contractions in bf16-policy
+    modules, skipping f32-gated branches."""
+
+    def scan(nodes: Iterable[ast.AST], f32_safe: bool):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, ast.If):
+                gate = _is_f32_gate(node.test)
+                scan([node.test], f32_safe)
+                scan(node.body, f32_safe or gate)
+                scan(node.orelse, f32_safe)
+                continue
+            if not f32_safe:
+                if isinstance(node, ast.BinOp) \
+                        and isinstance(node.op, ast.MatMult):
+                    ctx.add("R4", node,
+                            "`@` matmul without an f32-accumulating "
+                            "wrapper in a bf16-policy module (use "
+                            "lax.dot_general with preferred_element_type "
+                            "or gate the f32 path)")
+                elif isinstance(node, ast.Call):
+                    name = _trailing_name(node.func)
+                    d = _dotted(node.func)
+                    jaxish = d and d[0] in ("jnp", "jax", "lax")
+                    if name in _DOT_ATTRS and jaxish and not any(
+                            kw.arg == "preferred_element_type"
+                            for kw in node.keywords):
+                        ctx.add("R4", node,
+                                f"{name}() without preferred_element_"
+                                "type in a bf16-policy module — the MXU "
+                                "would accumulate in the operand dtype")
+            scan(ast.iter_child_nodes(node), f32_safe)
+
+    scan(getattr(ctx.info.node, "body", []), False)
+
+
+def _check_r5(ctx: _RuleContext, entry_names: Set[str]):
+    """Bare Python scalars at jitted-entry call sites."""
+    for node in _walk_own(ctx.info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _trailing_name(node.func)
+        if callee not in entry_names:
+            continue
+        static_pos: Tuple[int, ...] = STATIC_ARG_POSITIONS.get(callee, ())
+        if callee in DONATED_SIGS:
+            static_pos = DONATED_SIGS[callee][2]
+        for i, arg in enumerate(node.args):
+            if i in static_pos:
+                continue
+            bad = None
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, (int, float)) and not isinstance(
+                        arg.value, bool):
+                bad = f"literal {arg.value!r}"
+            elif isinstance(arg, ast.UnaryOp) and isinstance(
+                    arg.operand, ast.Constant):
+                bad = "signed literal"
+            elif isinstance(arg, ast.BinOp) and not isinstance(
+                    arg.op, ast.MatMult):
+                leaves = [n for n in ast.walk(arg)
+                          if isinstance(n, (ast.Name, ast.Constant))]
+                calls = [n for n in ast.walk(arg)
+                         if isinstance(n, ast.Call)]
+                if leaves and not calls:
+                    bad = "scalar arithmetic"
+            if bad:
+                ctx.add("R5", arg,
+                        f"{bad} passed positionally to jitted "
+                        f"{callee}() — weak-typed scalars retrace on "
+                        "dtype flips; wrap with np.int32/jnp.asarray "
+                        "(static args are exempt via DONATED_SIGS)")
+
+
+# ------------------------------------------------------------------ driver
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d != "__pycache__" and not d.startswith(".")]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _is_policy_module(path: str) -> bool:
+    parts = path.replace(os.sep, "/").split("/")
+    return "ops" in parts or "models" in parts
+
+
+def lint_files(files: Sequence[str], rules: Optional[Set[str]] = None,
+               root: Optional[str] = None) -> Tuple[List[Finding], int]:
+    """Raw (un-baselined) findings over ``files``.  ``root`` anchors the
+    repo-relative paths used in fingerprints (default: cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    rules = rules or {"R1", "R2", "R3", "R4", "R5"}
+    modules: List[ModuleIndex] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            rel = os.path.relpath(os.path.abspath(path), root)
+            modules.append(index_module(rel.replace(os.sep, "/"), source))
+        except (OSError, SyntaxError) as e:
+            raise RuntimeError(f"gsc-lint cannot parse {path}: {e}") from e
+
+    traced = traced_functions(modules)
+    # R5 call-site entry points: jit-decorated names are global (methods
+    # are called cross-module), but bare jit_refs stay module-local —
+    # `jax.jit(call)` in one tool must not flag every `call()` elsewhere
+    decorated_names = set(DONATED_SIGS)
+    for m in modules:
+        for info in m.functions.values():
+            if info.is_root:
+                decorated_names.add(info.name)
+
+    findings: List[Finding] = []
+    for m in modules:
+        policy_module = _is_policy_module(m.path)
+        entry_names = decorated_names | m.jit_refs
+        for info in m.functions.values():
+            ctx = _RuleContext(m, info, findings)
+            in_traced = (m.path, info.qualname) in traced
+            if in_traced and ("R1" in rules or "R3" in rules):
+                _check_r1_r3(ctx)
+            if "R2" in rules:
+                _check_r2(ctx)
+            if "R4" in rules and policy_module:
+                _check_r4(ctx)
+            if "R5" in rules:
+                _check_r5(ctx, entry_names)
+    # R1/R3 share one visitor, so filter to the requested subset here
+    findings = [f for f in findings if f.rule in rules]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, len(modules)
+
+
+def lint_paths(paths: Sequence[str], baseline_path: Optional[str] = None,
+               rules: Optional[Set[str]] = None,
+               root: Optional[str] = None) -> LintResult:
+    """Lint files/directories and apply the suppression baseline."""
+    files = _iter_py_files(paths)
+    raw, nfiles = lint_files(files, rules=rules, root=root)
+    entries = load_baseline(baseline_path)
+    if rules:
+        entries = [e for e in entries
+                   if e.get("rule") in rules or not e.get("rule")]
+    return build_result(raw, entries, nfiles)

@@ -1,0 +1,89 @@
+"""Finding records + stable fingerprints for gsc-lint.
+
+A finding pins a rule violation to (file, function, source line).  The
+fingerprint deliberately EXCLUDES the line number: refactors that shift
+code up or down must not invalidate the suppression baseline, so identity
+is the hash of (rule, relative path, enclosing qualname, normalized source
+text of the offending line).  Two identical lines in the same function
+share a fingerprint — suppressing one suppresses both, which is the
+conservative direction for a baseline (documented in tools/gsc_lint.py).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# rule ids are stable API — the baseline file, README table and fixture
+# tests all reference them
+RULE_IDS = ("R1", "R2", "R3", "R4", "R5")
+
+RULE_TITLES = {
+    "R1": "host-sync call inside jit-traced code",
+    "R2": "variable reused after being donated to a jitted call",
+    "R3": "impure host state (clock/RNG/global) inside jit-traced code",
+    "R4": "dot/einsum in a bf16-policy module without "
+          "preferred_element_type",
+    "R5": "bare Python scalar passed to a jitted entry point "
+          "(weak-type retrace)",
+}
+
+
+def fingerprint(rule: str, path: str, symbol: str, line_text: str) -> str:
+    """Line-number-independent identity of a finding (baseline key)."""
+    norm = "".join(line_text.split())
+    digest = hashlib.sha1(
+        f"{rule}|{path}|{symbol}|{norm}".encode()).hexdigest()
+    return digest[:16]
+
+
+@dataclass
+class Finding:
+    rule: str                 # "R1".."R5"
+    path: str                 # repo-relative posix path
+    line: int                 # 1-based
+    col: int
+    symbol: str               # enclosing function qualname ("<module>" ok)
+    message: str
+    line_text: str = ""       # stripped source of the offending line
+    suppressed_by: Optional[str] = None   # baseline reason / "inline"
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.rule, self.path, self.symbol,
+                           self.line_text)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "symbol": self.symbol,
+            "message": self.message, "line_text": self.line_text,
+            "fingerprint": self.fingerprint,
+            "suppressed_by": self.suppressed_by,
+        }
+
+
+@dataclass
+class LintResult:
+    """Partitioned outcome of one lint run."""
+
+    findings: List[Finding] = field(default_factory=list)    # unsuppressed
+    suppressed: List[Finding] = field(default_factory=list)
+    files: int = 0
+    # baseline entries whose fingerprint matched nothing this run — stale
+    # suppressions that should be pruned (reported, never fatal)
+    stale_suppressions: List[Dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out

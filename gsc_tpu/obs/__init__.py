@@ -26,7 +26,11 @@ pipelines honest; this package is that substrate:
   finishes within a wall budget.
 - :mod:`~gsc_tpu.obs.trace` — ``jax.profiler`` annotations so ``--profile``
   traces attribute device time to pipeline phases.
-- :class:`RunObserver` — the facade the trainer/CLI wire through.
+- :class:`RunObserver` — the facade the trainer/CLI wire through.  It
+  also owns a per-run retrace sentinel
+  (:class:`gsc_tpu.analysis.sentinels.CompileMonitor`): jit traces / XLA
+  compilations of watched entry points land as ``compile`` events in the
+  same stream, so a retrace storm is attributable from run telemetry.
 
 All later perf PRs report through this subsystem.
 """

@@ -27,7 +27,8 @@ class RunObserver:
     def __init__(self, out_dir: str, run_id: Optional[str] = None,
                  snapshot_interval: int = 10,
                  watchdog_budget_s: float = 0.0,
-                 tags: Optional[Dict[str, object]] = None):
+                 tags: Optional[Dict[str, object]] = None,
+                 compile_events: bool = True):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
@@ -45,6 +46,14 @@ class RunObserver:
             # note saying compile may dominate it.)
             self.watchdog = PipelineWatchdog(self.hub, watchdog_budget_s,
                                              start_paused=True)
+        # retrace sentinel (analysis.sentinels.CompileMonitor): counts jit
+        # traces / XLA compiles per watched entry point and emits one
+        # `compile` event per occurrence into events.jsonl — a retrace
+        # storm shows up in run telemetry, not just in wall time.  Created
+        # lazily in start() so constructing an observer never touches jax
+        # logging config.
+        self._compile_events = compile_events
+        self.compile_monitor = None
         self._drained = 0
         self._prev_phase_totals: Dict[str, float] = {}
         self._started = False
@@ -56,6 +65,9 @@ class RunObserver:
             return self
         self._started = True
         self.hub.event("run_start", **(meta or {}))
+        if self._compile_events:
+            from ..analysis.sentinels import CompileMonitor
+            self.compile_monitor = CompileMonitor(hub=self.hub).start()
         if self.watchdog is not None:
             self.watchdog.start()
         return self
@@ -67,6 +79,8 @@ class RunObserver:
         self._closed = True
         if self.watchdog is not None:
             self.watchdog.stop()
+        if self.compile_monitor is not None:
+            self.compile_monitor.stop()
         try:
             self.hub.event("run_end", status=status,
                            episodes=self._drained,
@@ -177,6 +191,15 @@ class RunObserver:
         if self._drained % self.snapshot_interval == 0:
             self.write_snapshot()
         return record
+
+    def invariant_violation(self, episode: int, violations: List[str]):
+        """Route a simulator-invariant failure through the same structured
+        pathway as the compile sentinel: a monotonic counter for
+        metrics.json diffs plus one event per occurrence in events.jsonl
+        (tools/obs_report.py lists both families)."""
+        self.hub.counter("invariant_violations_total", len(violations))
+        self.hub.event("invariant_violation", episode=episode,
+                       violations=violations)
 
     def eval_episode(self, episode: int, episodic_return: float,
                      succ_ratio: float, runtime_s: float):

@@ -1,0 +1,34 @@
+"""gsc-lint fixture: clean code — every rule must stay quiet here.
+
+Mirrors the repo's idioms: pure jitted kernels, donated carries rebound
+from the return, np.int32-pinned scalars, f32-gated contractions, and
+host-side numpy kept out of traced code.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x, step):
+    y = jnp.tanh(x) * step
+    return y.sum()
+
+
+def rollout(ddpg, state, buffer, env_state, obs, topo, traffic):
+    for ep in range(3):
+        state, buffer, env_state, obs, stats, m = ddpg.episode_step(
+            state, buffer, env_state, obs, topo, traffic, np.int32(ep))
+    return state, buffer, stats
+
+
+def drain(stats):
+    # host-side metric sync OUTSIDE any traced function — allowed
+    return {k: float(np.asarray(v)) for k, v in stats.items()}
+
+
+def inline_suppressed(x):
+    @jax.jit
+    def inner(v):
+        return v.item()   # gsc-lint: disable=R1 fixture-only: exercised by tests
+    return inner(x)

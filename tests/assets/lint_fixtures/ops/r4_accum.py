@@ -1,0 +1,34 @@
+"""gsc-lint fixture: R4 — contractions in a bf16-policy module (the file
+lives under an ``ops/`` directory) without ``preferred_element_type``.
+
+Seeded violations: an unguarded einsum and a bare ``@`` matmul.
+The f32-gated branch and the preferred_element_type call are clean.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def attention(q, k, compute_dtype=None):
+    logits = jnp.einsum("...if,...jf->...ij", q, k)   # SEED R4
+    return logits
+
+
+def project(x, w, b):
+    return x @ w + b                                   # SEED R4: bare matmul
+
+
+def guarded(x, w, compute_dtype=None):
+    # NOT violations: the f32 gate takes the verbatim legacy path, the low
+    # precision path accumulates f32 on the MXU
+    if compute_dtype is None:
+        return jnp.einsum("nf,fk->nk", x, w)
+    return jax.lax.dot_general(
+        x.astype(compute_dtype), w.astype(compute_dtype),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def dtype_eq_gate(xl, w):
+    if xl.dtype == jnp.float32:
+        return jnp.dot(xl, w)           # NOT a violation: f32-gated branch
+    return jnp.dot(xl, w, preferred_element_type=jnp.float32)

@@ -1,0 +1,34 @@
+"""gsc-lint fixture: R1 host-sync calls inside jit-traced code.
+
+Seeded violations (each line tagged SEED):
+- ``.item()`` directly in a jitted function
+- ``np.asarray`` in a helper reachable from the jitted function
+- ``float()`` on a traced value in a lax.scan body
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x):
+    return np.asarray(x).sum()          # SEED R1: np.asarray in traced code
+
+
+@jax.jit
+def jitted_entry(x):
+    y = x * 2
+    z = y[0].item()                     # SEED R1: .item() in traced code
+    return helper(y) + z
+
+
+def scan_driver(xs):
+    def body(carry, x):
+        v = float(x)                    # SEED R1: float() on a traced value
+        return carry + v, carry
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def host_only(x):
+    # NOT a violation: this function is never reachable from traced code
+    return np.asarray(x)

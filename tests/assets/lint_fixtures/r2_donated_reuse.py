@@ -1,0 +1,31 @@
+"""gsc-lint fixture: R2 use-after-donation (the PR 1 bug class).
+
+Seeded violations:
+- reading ``buffer`` after donating it to ``episode_step`` without
+  rebinding it from the return
+- cross-iteration reuse: ``state`` donated at the tail of a loop body and
+  read at the head of the next iteration
+"""
+
+
+def leaky_loop(ddpg, state, buffer, env_state, obs, topo, traffic, step):
+    out = ddpg.episode_step(state, buffer, env_state, obs, topo, traffic,
+                            step)
+    new_state = out[0]
+    size = buffer.size                  # SEED R2: buffer was donated above
+    return new_state, size
+
+
+def cross_iteration(ddpg, state, buffers):
+    for _ in range(3):
+        metrics = ddpg.learn_burst(state)   # SEED R2 (2nd iteration):
+        _ = metrics                          # state donated, never rebound
+    return state
+
+
+def clean_loop(ddpg, state, buffer, env_state, obs, topo, traffic, step):
+    # NOT a violation: every donated carry is rebound from the return
+    for _ in range(3):
+        state, buffer, env_state, obs, stats, m = ddpg.episode_step(
+            state, buffer, env_state, obs, topo, traffic, step)
+    return state, buffer

@@ -1,0 +1,27 @@
+"""gsc-lint fixture: R3 impure host state inside jit-traced code.
+
+Seeded violations: wall clock, Python RNG, NumPy RNG and a ``global``
+mutation — all frozen at trace time, silently stale thereafter.
+"""
+import random
+import time
+
+import jax
+import numpy as np
+
+COUNTER = 0
+
+
+@jax.jit
+def jitted_entry(x):
+    t = time.time()                     # SEED R3: host clock at trace time
+    r = random.random()                 # SEED R3: Python RNG at trace time
+    n = np.random.rand()                # SEED R3: NumPy RNG at trace time
+    return x + t + r + n
+
+
+@jax.jit
+def jitted_counter(x):
+    global COUNTER                      # SEED R3: global mutation in trace
+    COUNTER += 1
+    return x + COUNTER

@@ -1,0 +1,20 @@
+"""gsc-lint fixture: R5 — bare Python scalars at jitted call sites.
+
+Seeded violations: an int literal and scalar arithmetic passed
+positionally to a jit-decorated function (weak-typed scalars retrace when
+the dtype flips); the np.int32-wrapped call is clean.
+"""
+import jax
+import numpy as np
+
+
+@jax.jit
+def kernel(x, step):
+    return x * step
+
+
+def driver(x, ep, steps_per_ep):
+    a = kernel(x, 0)                          # SEED R5: literal scalar
+    b = kernel(x, ep * steps_per_ep)          # SEED R5: scalar arithmetic
+    c = kernel(x, np.int32(ep * steps_per_ep))   # NOT a violation: pinned
+    return a + b + c

@@ -243,6 +243,15 @@ def test_end_to_end_train_run_event_schema(tmp_path):
     assert summary["episodes"] == 3
     assert summary["stalls"] == []
     assert summary["status"] == "ok"
+
+    # retrace sentinel: the fused episode kernel's compile is a structured
+    # event in the same stream, surfaced by the report's compile summary
+    compiles = [e for e in events if e["event"] == "compile"]
+    assert any(e["fn"] == "episode_step" and e["stage"] == "trace"
+               for e in compiles), compiles
+    per_fn = summary["compiles"]["per_fn"]
+    assert per_fn["episode_step"]["traces"] == 1, per_fn
+    assert summary["compiles"]["retrace_flags"] == []
     obs_report.render_text(summary, out=open(os.devnull, "w"))
 
 

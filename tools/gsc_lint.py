@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""gsc-lint CLI — JAX-aware static analysis for this repo.
+
+Usage:
+    python tools/gsc_lint.py [paths...]            # default: gsc_tpu/ tools/ bench.py
+    python tools/gsc_lint.py --json [paths...]
+    python tools/gsc_lint.py --rules R1,R4 [paths...]
+    python tools/gsc_lint.py --write-baseline      # accept current findings
+    python tools/gsc_lint.py --no-baseline         # raw findings, no suppressions
+
+Rules (gsc_tpu/analysis/astlint.py):
+    R1  host-sync calls (.item(), float()/int() on arrays, np.asarray,
+        block_until_ready, device_get) reachable from jitted/scanned code
+    R2  use of a variable after it was passed as a donated argument
+    R3  time.time()/Python RNG/global mutation inside traced code
+    R4  dot/einsum in bf16-policy modules (ops/, models/) missing
+        preferred_element_type
+    R5  bare Python scalars passed to jitted entry points (weak-type
+        retrace risk)
+
+Exit status: 0 when every finding is suppressed (baseline or inline
+``gsc-lint: disable=R<k>`` marker), 1 when new findings exist, 2 on usage
+errors.  The baseline lives at tools/gsc_lint_baseline.json; every entry
+carries a one-line reason.  ``--write-baseline`` rewrites it from the
+current findings, preserving existing reasons; entries it has to stamp
+with a TODO reason make the write exit 1 until a human replaces them —
+an unreviewed suppression must not pass the gate.
+
+Fingerprints hash (rule, path, function, source-line text), not line
+numbers, so code motion does not invalidate suppressions; two identical
+lines in one function share a fingerprint (suppressing one suppresses
+both).  Stale baseline entries (matching nothing) are reported but never
+fatal.  Stdlib-only: runs without jax / device init.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from gsc_tpu.analysis import (  # noqa: E402
+    RULE_IDS, RULE_TITLES, lint_paths, load_baseline, save_baseline)
+from gsc_tpu.analysis.astlint import _iter_py_files, lint_files  # noqa: E402
+
+DEFAULT_PATHS = ("gsc_tpu/", "tools/", "bench.py")
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "gsc_lint_baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog="\n".join(f"  {r}  {RULE_TITLES[r]}" for r in RULE_IDS))
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint [default: {DEFAULT_PATHS}]")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression baseline JSON "
+                         "[default: tools/gsc_lint_baseline.json]")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report every finding)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(existing reasons preserved; new entries get a "
+                         "TODO reason)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset, e.g. R1,R4")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="findings only, no summary lines")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",")}
+        bad = rules - set(RULE_IDS)
+        if bad:
+            ap.error(f"unknown rule(s): {sorted(bad)}")
+
+    paths = args.paths or [os.path.join(REPO_ROOT, p)
+                           for p in DEFAULT_PATHS]
+    for p in paths:
+        if not os.path.exists(p):
+            ap.error(f"no such path: {p}")
+        if os.path.isfile(p) and not p.endswith(".py"):
+            # _iter_py_files would silently drop it and report a clean
+            # "0 files" run — an explicit unlintable file is a usage error
+            ap.error(f"not a Python file: {p}")
+
+    if args.write_baseline:
+        from gsc_tpu.analysis import inline_suppression
+
+        files = _iter_py_files(paths)
+        raw, _ = lint_files(files, rules=rules, root=REPO_ROOT)
+        # inline-marked findings are already suppressed at their source
+        # line; a baseline entry for one would match nothing on the next
+        # run and report as stale
+        raw = [f for f in raw
+               if not inline_suppression(f.line_text, f.rule)]
+        existing = (load_baseline(args.baseline)
+                    if os.path.exists(args.baseline) else [])
+        # a scoped rewrite (--rules subset / explicit path subset) only
+        # re-checked part of the tree: entries outside that scope are
+        # preserved verbatim, never silently dropped
+        linted_rel = {
+            os.path.relpath(os.path.abspath(f),
+                            REPO_ROOT).replace(os.sep, "/")
+            for f in files}
+        preserved = [
+            e for e in existing
+            if (rules is not None and e.get("rule") not in rules)
+            or e.get("path") not in linted_rel]
+        n = save_baseline(args.baseline, raw, existing=existing,
+                          preserve=preserved)
+        print(f"gsc-lint: baseline rewritten with {n} suppression(s) -> "
+              f"{args.baseline}")
+        todo = sum(1 for e in load_baseline(args.baseline)
+                   if e["reason"].startswith("TODO"))
+        if todo:
+            # exit non-zero: an unreviewed TODO reason must not slip
+            # through the CI gate as an accepted suppression
+            print(f"gsc-lint: {todo} entries need a written reason "
+                  "(search for TODO) before the baseline is reviewable")
+            return 1
+        return 0
+
+    result = lint_paths(
+        paths, baseline_path=None if args.no_baseline else args.baseline,
+        rules=rules, root=REPO_ROOT)
+
+    if args.as_json:
+        json.dump({
+            "files": result.files,
+            "findings": [f.to_json() for f in result.findings],
+            "suppressed": [f.to_json() for f in result.suppressed],
+            "stale_suppressions": result.stale_suppressions,
+            "by_rule": result.by_rule(),
+            "ok": result.ok,
+        }, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.format())
+    if not args.quiet:
+        by_rule = result.by_rule()
+        detail = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+        print(f"gsc-lint: {result.files} files, "
+              f"{len(result.findings)} finding(s)"
+              + (f" ({detail})" if detail else "")
+              + f", {len(result.suppressed)} suppressed")
+        for e in result.stale_suppressions:
+            print(f"gsc-lint: stale suppression (matched nothing): "
+                  f"{e['fingerprint']} {e.get('path', '?')} — consider "
+                  "pruning")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

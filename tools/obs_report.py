@@ -14,6 +14,13 @@ does by default), prints:
   per-episode *delta* of each pipeline phase's host wall (the stream
   carries cumulative ``PhaseTimer`` totals), and device bytes-in-use;
 - a final per-phase summary (total wall, mean ms per episode);
+- a jit-compile summary from the retrace sentinel's ``compile`` events
+  (gsc_tpu.analysis.sentinels.CompileMonitor): traces / XLA compiles and
+  compile seconds per jitted entry point, with a retrace-churn flag when
+  an entry point traced more than ``--retrace-threshold`` times (a
+  steady-state pipelined loop traces each entry point once per static-arg
+  variant; more means weak-type scalars or shape drift re-triggering
+  tracing);
 - every ``stall`` / ``invariant_violation`` record, verbatim fields;
 - a device-memory growth check: bytes_in_use at the first vs last episode
   per device, flagged when growth exceeds ``--mem-growth-threshold``
@@ -93,7 +100,34 @@ def last_run(events: List[Dict]) -> List[Dict]:
     return events[starts[-1]:] if starts else events
 
 
-def summarize(events: List[Dict], mem_growth_threshold: float = 0.2) -> Dict:
+def compile_summary(events: List[Dict],
+                    retrace_threshold: int = 3) -> Dict:
+    """Per-entry-point jit trace/compile totals from ``compile`` events,
+    plus the names whose trace count exceeds the churn threshold."""
+    per_fn: Dict[str, Dict] = {}
+    for ev in events:
+        if ev.get("event") != "compile":
+            continue
+        fn = ev.get("fn", "?")
+        rec = per_fn.setdefault(
+            fn, {"traces": 0, "xla_compiles": 0, "compile_s": 0.0})
+        # compile_s totals BOTH stages: tracing+transform wall is often
+        # the dominant share for large fused programs
+        if ev.get("stage") == "trace":
+            rec["traces"] += 1
+            rec["compile_s"] = round(
+                rec["compile_s"] + float(ev.get("duration_s") or 0.0), 4)
+        elif ev.get("stage") == "xla":
+            rec["xla_compiles"] += 1
+            rec["compile_s"] = round(
+                rec["compile_s"] + float(ev.get("duration_s") or 0.0), 4)
+    flags = sorted(fn for fn, rec in per_fn.items()
+                   if rec["traces"] > retrace_threshold)
+    return {"per_fn": per_fn, "retrace_flags": flags}
+
+
+def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
+              retrace_threshold: int = 3) -> Dict:
     runs_in_stream = max(
         sum(1 for e in events if e.get("event") == "run_start"), 1)
     events = last_run(events)
@@ -172,6 +206,7 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2) -> Dict:
         "invariant_violations": violations,
         "memory_growth_flags": mem_flags,
         "drop_totals": _drop_totals(episodes),
+        "compiles": compile_summary(events, retrace_threshold),
     }
 
 
@@ -226,6 +261,16 @@ def render_text(summary: Dict, out=sys.stdout):
     if summary["drop_totals"]:
         w("\nsim drop totals: "
           + json.dumps(summary["drop_totals"]) + "\n")
+    compiles = summary.get("compiles") or {}
+    if compiles.get("per_fn"):
+        w("\njit compiles (retrace sentinel):\n")
+        for fn, rec in sorted(compiles["per_fn"].items()):
+            w(f"  {fn:<20} traces {rec['traces']:>3}   xla "
+              f"{rec['xla_compiles']:>3}   compile {rec['compile_s']:>8}s\n")
+    if compiles.get("retrace_flags"):
+        w(f"\n!! RETRACE CHURN: {', '.join(compiles['retrace_flags'])} "
+          "traced more than the steady-state budget — look for weak-type "
+          "scalars or shape drift in the episode loop\n")
     if summary["stalls"]:
         w(f"\n!! {len(summary['stalls'])} STALL(s):\n")
         for s in summary["stalls"]:
@@ -247,9 +292,10 @@ def render_text(summary: Dict, out=sys.stdout):
             w(f"  {m['device']}: {m['first_bytes']} -> {m['last_bytes']} "
               f"bytes (+{m['growth_pct']}%)\n")
     if not (summary["stalls"] or summary["invariant_violations"]
-            or summary["memory_growth_flags"]):
+            or summary["memory_growth_flags"]
+            or (summary.get("compiles") or {}).get("retrace_flags")):
         w("\nhealthy: no stalls, no invariant violations, no device "
-          "memory growth\n")
+          "memory growth, no retrace churn\n")
 
 
 # ------------------------------------------------------------------ selftest
@@ -268,6 +314,18 @@ def _synthetic_events(path: str, episodes: int = 5):
               "name": "bf16", "param_dtype": "float32",
               "gnn_compute": "bfloat16", "mlp_compute": "bfloat16",
               "replay_dtype": "bfloat16"})
+        # retrace-sentinel events: one healthy entry point (single trace
+        # + compile) and one churning (retraces every episode)
+        emit({"event": "compile", "ts": base, "run": "selftest",
+              "fn": "episode_step", "stage": "trace",
+              "duration_s": 0.8, "count": 1})
+        emit({"event": "compile", "ts": base, "run": "selftest",
+              "fn": "episode_step", "stage": "xla",
+              "duration_s": 2.5, "count": 1})
+        for k in range(5):
+            emit({"event": "compile", "ts": base + k, "run": "selftest",
+                  "fn": "leaky_fn", "stage": "trace",
+                  "duration_s": 0.1, "count": k + 1})
         disp = drain = 0.0
         for ep in range(episodes):
             disp += 0.010
@@ -318,6 +376,12 @@ def selftest() -> int:
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1
         assert summary["memory_growth_flags"], "memory growth not flagged"
+        comp = summary["compiles"]["per_fn"]
+        # 0.8 s trace + 2.5 s xla: both stages count as compile wall
+        assert comp["episode_step"] == {
+            "traces": 1, "xla_compiles": 1, "compile_s": 3.3}, comp
+        assert summary["compiles"]["retrace_flags"] == ["leaky_fn"], \
+            "retrace churn not flagged"
         assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
         deltas = phase_deltas([e for e in last_run(load_events(path))
                                if e.get("event") == "episode"])
@@ -344,6 +408,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--mem-growth-threshold", type=float, default=0.2,
                     help="fractional bytes_in_use growth (first->last "
                          "episode) flagged as a leak [default 0.2]")
+    ap.add_argument("--retrace-threshold", type=int, default=3,
+                    help="traces per jitted entry point above which "
+                         "retrace churn is flagged [default 3]")
     ap.add_argument("--selftest", action="store_true",
                     help="synthesize a stream and verify the report "
                          "flags its stall/leak (CI smoke target)")
@@ -353,7 +420,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.path:
         ap.error("path required (or --selftest)")
     summary = summarize(load_events(args.path),
-                        mem_growth_threshold=args.mem_growth_threshold)
+                        mem_growth_threshold=args.mem_growth_threshold,
+                        retrace_threshold=args.retrace_threshold)
     if args.json:
         json.dump(summary, sys.stdout, indent=1)
         sys.stdout.write("\n")
