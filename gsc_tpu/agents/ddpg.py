@@ -48,6 +48,7 @@ from flax import struct
 from ..config.schema import AgentConfig
 from ..env.env import ServiceCoordEnv
 from ..models.nets import Actor, QNetwork, scale_action, unscale_action
+from ..resilience.guard import all_finite
 from .buffer import ReplayBuffer, buffer_add, buffer_init, buffer_sample
 
 
@@ -226,6 +227,12 @@ class DDPG:
             "mean_succ_ratio": stats["succ_ratio"].mean(),
             "mean_e2e_delay": stats["avg_e2e_delay"].mean(),
             "final_succ_ratio": stats["succ_ratio"][-1],
+            # divergence guardrail (resilience.guard): all-finite flag over
+            # the learner state ENTERING this episode, computed on device
+            # and drained with the deferred metrics — catches a poisoned
+            # state even during warmup, when no learn burst runs (the
+            # post-update flag lives in the learn metrics)
+            "state_finite": all_finite(state),
         }
         return state.replace(rng=rng), buffer, env_state, obs, episode_stats
 
@@ -348,6 +355,10 @@ class DDPG:
         n_steps = (self.agent.learn_steps if self.agent.learn_steps
                    is not None else self.agent.episode_steps)
         state, metrics = jax.lax.fori_loop(0, n_steps, body, (state, zero))
+        # divergence guardrail: flag the POST-update learner state in the
+        # same device program (no extra host sync — the trainer reads it
+        # from the deferred metric drain and rolls back on violation)
+        metrics = {**metrics, "state_finite": all_finite(state)}
         return state.replace(rng=rng), metrics
 
     @partial(jax.jit, static_argnums=0)

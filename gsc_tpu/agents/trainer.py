@@ -33,6 +33,10 @@ from ..config.schema import DROP_REASONS, AgentConfig
 from ..env.driver import EpisodeDriver
 from ..env.env import ServiceCoordEnv
 from ..obs.trace import episode_span, phase_span
+from ..resilience.faults import FaultInjected
+from ..resilience.guard import RollbackGuard, poison_tree
+from ..resilience.retry import (RetryPolicy, TransientDispatchError,
+                                call_with_retry)
 from ..utils.debug import check_invariants
 from ..utils.telemetry import PhaseTimer
 from .buffer import buffer_nbytes
@@ -70,11 +74,38 @@ class Trainer:
                  result_dir: Optional[str] = None,
                  tensorboard: bool = False, gnn_impl: str = None,
                  donate: bool = True, obs=None,
-                 check_invariants: bool = False):
+                 check_invariants: bool = False,
+                 fault_plan=None, rollback: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 pipeline_fault_limit: int = 3):
         self.env = env
         self.driver = driver
         self.agent_cfg = agent_cfg
         self.seed = seed
+        # --- resilience (gsc_tpu.resilience) -------------------------
+        # fault_plan: deterministic injection schedule (FaultPlan) — None
+        # in production; every recovery path below has a test through it
+        self.fault_plan = fault_plan
+        # rollback=True keeps a last-good in-memory snapshot of the
+        # (state, replay) carries and restores it when the on-device
+        # all-finite guard flags a poisoned learner state.  Costs two
+        # device-side pytree copies per episode + ~2 retained replay
+        # copies in HBM; with no violation the training math is
+        # bit-identical either way (copies never enter the update path).
+        self.rollback = rollback
+        self.retry_policy = retry_policy or RetryPolicy()
+        # pipeline faults (prefetcher death / watchdog-escalation
+        # interrupts) beyond this limit degrade pipeline -> off for the
+        # remainder of the run: serial host sampling + immediate drains
+        # (the fused dispatch kernel itself is unaffected)
+        self.pipeline_fault_limit = pipeline_fault_limit
+        # set by train()/train_parallel(): episodes completed when the
+        # loop exited (monotone resume counter) and whether a preemption
+        # guard stopped it early — the CLI checkpoints off these
+        self.completed_episodes = 0
+        self.preempted = False
+        self._last_drained = -1
+        self._live_prefetch = None   # watchdog-escalation interrupt target
         # run observability (gsc_tpu.obs.RunObserver): events.jsonl +
         # metrics.json + device gauges + pipeline watchdog.  The trainer
         # only reports into it; lifecycle (start/close) belongs to the
@@ -132,15 +163,22 @@ class Trainer:
                                    global_step)
 
     def _drain(self, entry, start_time: float, start_episode: int,
-               verbose: bool, timer):
+               verbose: bool, timer) -> bool:
         """Sync one pending episode's device metrics to host and log it.
         On the pipelined path this runs one episode BEHIND the dispatch
         head, so the ``np.asarray`` syncs here wait on device work that has
         already been followed by the next episode's dispatch — the chip
-        never idles on host-side logging."""
+        never idles on host-side logging.
+
+        Returns the episode's all-finite verdict (the on-device guard
+        flags computed inside ``episode_step``, drained here with the
+        other deferred metrics): False means the learner state this
+        episode saw or produced is poisoned and the caller should roll
+        back."""
         ep, end_step, stats, learn_metrics, trunc_dev, sim, topo, \
             replay_bytes = entry
         hub = self.obs.hub if self.obs else None
+        finite = True
         with phase_span("drain", timer, hub):
             # force the episode's device work complete BEFORE reading the
             # wall clock: sps must divide by time that includes the
@@ -159,6 +197,16 @@ class Trainer:
                     "episode=%d: %d arrivals admitted late (flow-table "
                     "slot exhaustion) — raise SimConfig.max_flows to "
                     "restore exact arrival timing", ep, trunc)
+            # divergence verdict: the rollout flag covers the state the
+            # episode STARTED from, the learn flag the post-update state
+            # — both already synced by the block above, so these asarray
+            # reads are free
+            if "state_finite" in stats:
+                finite = bool(np.asarray(stats["state_finite"]) > 0)
+            if learn_metrics is not None \
+                    and "state_finite" in learn_metrics:
+                finite = finite and bool(
+                    np.asarray(learn_metrics["state_finite"]) > 0)
             self._log(ep, end_step, stats, learn_metrics, sps)
             if verbose:
                 # per-episode progress line (the reference's tqdm + SPS
@@ -198,12 +246,114 @@ class Trainer:
                     DROP_REASONS,
                     np.asarray(sim.metrics.drop_reasons).tolist())),
                 truncated_arrivals=trunc, replay_bytes=replay_bytes)
+        return finite
+
+    # ---------------------------------------------------------- resilience
+    def _recover(self, episode: int, site: str, action: str,
+                 fault: Optional[str] = None, attempt: Optional[int] = None,
+                 detail: Optional[str] = None):
+        """Log + emit one structured ``recovery`` event (obs.RunObserver)
+        for a self-healing action — the recovery timeline every fault
+        path below reports through."""
+        log.warning("recovery: site=%s action=%s episode=%s fault=%s%s",
+                    site, action, episode, fault,
+                    f" ({detail})" if detail else "")
+        if self.obs is not None:
+            self.obs.recovery(episode=episode, site=site, action=action,
+                              fault=fault, attempt=attempt, detail=detail)
+
+    def _prefetch_fault_hook(self):
+        """``before_episode`` hook for the prefetcher's producer thread —
+        the injection point of the two producer-side fault sites."""
+        plan = self.fault_plan
+        if plan is None:
+            return None
+
+        def hook(ep: int, stop_event):
+            spec = plan.fire("slow_episode", ep)
+            if spec is not None:
+                # interruptible: wakes the moment close() abandons this
+                # producer (so an escalation-triggered restart is not
+                # gated on the full injected delay)
+                stop_event.wait(spec.arg if spec.arg is not None else 1.0)
+            spec = plan.fire("prefetch_die", ep)
+            if spec is not None:
+                raise FaultInjected(
+                    f"injected prefetcher death at episode {ep}")
+        return hook
+
+    def _on_watchdog_escalate(self, age: float):
+        """Watchdog escalation callback (runs on the watchdog thread):
+        interrupt the live prefetcher so the training loop — possibly
+        blocked inside ``prefetch.get`` — wakes with a
+        ``PrefetchInterrupted`` and restarts it from the episode counter
+        (safe: the pipeline is bit-identical to serial sampling, so
+        re-staging an episode reproduces it exactly)."""
+        pf = self._live_prefetch
+        if pf is not None:
+            pf.interrupt(f"watchdog escalation: no completed episode in "
+                         f"{age:.1f}s")
+
+    def _dispatch_with_retry(self, ep, pipeline, state, buffer, env_state,
+                             obs, topo, traffic, global_step, learn, timer,
+                             hub):
+        """One episode's device dispatch under the bounded-backoff retry
+        policy.  Returns the 6-tuple (state, buffer, env_state, obs,
+        stats, learn_metrics) on both dispatch shapes.
+
+        The injected ``dispatch_transient`` fault raises at call entry —
+        before the kernels consume any donated carry — so a retry
+        re-dispatches untouched buffers; a REAL transient that aborted
+        mid-program may have invalidated them, in which case the retry
+        fails fast with XLA's donation error and propagates (see
+        resilience.retry)."""
+        plan = self.fault_plan
+
+        # one donating call site per function scope: gsc-lint's R2
+        # use-after-donation scan is linear and would read the serial
+        # branch's rollout_episode(state, ...) as a use after the fused
+        # branch's episode_step donated `state` — mutually exclusive
+        # branches, but split closures make that obvious to the tool too
+        def dispatch_fused():
+            with phase_span("dispatch", timer, hub), episode_span(ep):
+                return self.ddpg.episode_step(
+                    state, buffer, env_state, obs, topo, traffic,
+                    np.int32(global_step), learn=learn)
+
+        def dispatch_serial():
+            with phase_span("dispatch", timer, hub), episode_span(ep):
+                st, buf, es, ob, stats = self.ddpg.rollout_episode(
+                    state, buffer, env_state, obs, topo, traffic,
+                    np.int32(global_step))
+                metrics = None
+                if learn:
+                    st, metrics = self.ddpg.learn_burst(st, buf)
+                return st, buf, es, ob, stats, metrics
+
+        body = dispatch_fused if pipeline else dispatch_serial
+
+        def dispatch():
+            if plan is not None:
+                spec = plan.fire("dispatch_transient", ep)
+                if spec is not None:
+                    raise TransientDispatchError(
+                        "injected transient dispatch failure at episode "
+                        f"{ep}")
+            return body()
+
+        return call_with_retry(
+            dispatch, self.retry_policy,
+            on_retry=lambda attempt, exc, delay: self._recover(
+                ep, site="dispatch", action="retry", fault=repr(exc),
+                attempt=attempt,
+                detail=f"backing off {delay:.2f}s before re-dispatch"))
 
     def train(self, episodes: int, test_mode: bool = False,
               verbose: bool = False, profile: bool = False,
               init_state: Optional[DDPGState] = None,
               init_buffer=None, start_episode: int = 0,
-              pipeline: bool = True):
+              pipeline: bool = True, ckpt_manager=None,
+              ckpt_interval: int = 0, preempt=None):
         """Train through episode ``episodes - 1`` (train-at-episode-end
         schedule, simple_ddpg.py:280-329).  Returns (final learner state,
         replay buffer).  With ``profile`` a jax profiler trace of the run is
@@ -223,7 +373,24 @@ class Trainer:
         the host-side stream needs no replay (the device-side stream lives
         in DDPGState.rng, which the checkpoint carries).  The reference
         cannot do this: it never saves optimizer or replay state
-        (main.py:46-50, SURVEY.md §5)."""
+        (main.py:46-50, SURVEY.md §5).
+
+        Self-healing (gsc_tpu.resilience), every action a structured
+        ``recovery`` event:
+
+        - transient dispatch failures retry with bounded exponential
+          backoff (``Trainer(retry_policy=...)``);
+        - a dead/interrupted prefetcher is restarted from the episode
+          counter (bit-identical re-staging), and past
+          ``pipeline_fault_limit`` faults the run degrades pipeline->off;
+        - a non-finite learner state (on-device guard flags drained with
+          the deferred metrics) rolls back to the last-good snapshot and
+          skips the poisoned episode(s);
+        - ``ckpt_manager`` + ``ckpt_interval`` write checksummed periodic
+          checkpoints of the last VERIFIED state;
+        - ``preempt`` (a resilience.PreemptionGuard) stops the loop at the
+          next episode boundary after SIGTERM/SIGINT — the caller then
+          snapshots ``(state, buffer)`` at ``self.completed_episodes``."""
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
@@ -231,11 +398,22 @@ class Trainer:
                                   profile=False, init_state=init_state,
                                   init_buffer=init_buffer,
                                   start_episode=start_episode,
-                                  pipeline=pipeline)
+                                  pipeline=pipeline,
+                                  ckpt_manager=ckpt_manager,
+                                  ckpt_interval=ckpt_interval,
+                                  preempt=preempt)
         self.phase_timer = timer = PhaseTimer()
         hub = self.obs.hub if self.obs else None
         base = jax.random.PRNGKey(self.seed)
         steps_per_ep = self.agent_cfg.episode_steps
+        plan = self.fault_plan
+        guard = RollbackGuard() if self.rollback else None
+        self.preempted = False
+        self._last_drained = start_episode - 1
+        if ckpt_interval and ckpt_manager is not None and guard is None:
+            log.warning("periodic checkpoints need the rollback guard's "
+                        "verified snapshots (Trainer(rollback=True)) — "
+                        "--ckpt-interval is ignored this run")
 
         if self.ddpg.donate:
             # restored carries (orbax checkpoints, caller-held pytrees) may
@@ -249,39 +427,78 @@ class Trainer:
             if init_buffer is not None:
                 init_buffer = jax.tree_util.tree_map(jnp.copy, init_buffer)
 
-        prefetch = None
-        if pipeline:
+        def make_prefetcher(from_ep):
             # traffic staged to device FROM THE PREFETCH THREAD, so the
             # host→device transfer also overlaps the running episode; the
             # topology object passes through untouched (it is the driver's
             # cached pytree — id()-keyed caches downstream rely on that)
             # stop bound covers the unconditional initial sample even when
             # the episode range is empty (the serial loop's behavior)
-            prefetch = self.driver.prefetcher(
-                start_episode, max(episodes, start_episode + 1), test_mode,
+            pf = self.driver.prefetcher(
+                from_ep, max(episodes, start_episode + 1), test_mode,
                 stage=lambda topo, traffic: (topo, jax.device_put(traffic)),
                 heartbeat=(self.obs.prefetcher_heartbeat()
-                           if self.obs else None))
+                           if self.obs else None),
+                before_episode=self._prefetch_fault_hook())
+            self._live_prefetch = pf
             if self.obs:
-                self.obs.attach_prefetcher(prefetch)
+                self.obs.attach_prefetcher(pf)
+            return pf
+
+        prefetch = make_prefetcher(start_episode) if pipeline else None
+        pipeline_faults = 0
         if self.obs:
+            if self.obs.watchdog is not None:
+                # escalation target for the duration of the episode loop:
+                # the watchdog interrupts the live prefetcher; the loop's
+                # recovery path below does the restart
+                self.obs.watchdog.on_escalate = self._on_watchdog_escalate
             # arm the stall monitor only while the episode loop runs —
             # compile/eval/checkpoint time is not a pipeline stall
             self.obs.resume_watchdog()
-
-        def next_episode(ep):
-            if prefetch is not None:
-                # blocks only when the producer thread is behind — i.e.
-                # host sampling is the true bottleneck, not the sync order
-                with phase_span("host_sample_wait", timer, hub):
-                    return prefetch.get(ep)
-            with phase_span("host_sample", timer, hub):
-                return self.driver.episode(ep, test_mode)
 
         pending = []  # dispatched episodes whose metrics are not yet synced
         # serial path drains immediately (the seed behavior); pipelined
         # drains lag one episode so the sync never gates the next dispatch
         max_pending = 1 if pipeline else 0
+
+        def next_episode(ep):
+            nonlocal prefetch, pipeline_faults, max_pending
+            while prefetch is not None:
+                try:
+                    # blocks only when the producer thread is behind —
+                    # i.e. host sampling is the true bottleneck, not the
+                    # sync order
+                    with phase_span("host_sample_wait", timer, hub):
+                        return prefetch.get(ep)
+                except RuntimeError as e:
+                    # pipeline fault: producer death (surfaced error) or
+                    # a watchdog-escalation interrupt.  Restart from the
+                    # episode counter — staging is keyed purely by episode
+                    # index, so the restarted sequence is bit-identical —
+                    # or degrade pipeline->off past the fault limit.
+                    pipeline_faults += 1
+                    prefetch.close()
+                    fault = f"{type(e).__name__}: {e}"
+                    if pipeline_faults > self.pipeline_fault_limit:
+                        prefetch = None
+                        self._live_prefetch = None
+                        max_pending = 0
+                        self._recover(
+                            ep, site="pipeline", action="pipeline_off",
+                            fault=fault, attempt=pipeline_faults,
+                            detail=f"{pipeline_faults} pipeline faults > "
+                                   f"limit {self.pipeline_fault_limit}; "
+                                   "serial sampling + immediate drains "
+                                   "for the rest of the run")
+                    else:
+                        self._recover(
+                            ep, site="prefetcher", action="restart",
+                            fault=fault, attempt=pipeline_faults,
+                            detail=f"re-staging from episode {ep}")
+                        prefetch = make_prefetcher(ep)
+            with phase_span("host_sample", timer, hub):
+                return self.driver.episode(ep, test_mode)
         try:
             topo, traffic = next_episode(start_episode)
             env_state, obs = self.env.reset(
@@ -302,8 +519,69 @@ class Trainer:
                     if self.ddpg.donate else
                     " — copied each episode (donate=False)")
 
+            if guard is not None:
+                # rollback target for a violation before any episode has
+                # been verified (the fresh/restored state is finite)
+                guard.init(start_episode - 1, state, buffer)
+
             start = time.time()
+
+            def drain_one():
+                """Drain the oldest pending episode; on a finite verdict
+                promote snapshots + periodic-checkpoint, on a violation
+                roll back and drop the in-flight descendants."""
+                nonlocal state, buffer
+                entry = pending.pop(0)
+                k = entry[0]
+                finite = self._drain(entry, start, start_episode, verbose,
+                                     timer)
+                if finite:
+                    self._last_drained = max(self._last_drained, k)
+                    if guard is not None:
+                        guard.promote(k, state, buffer,
+                                      pending_empty=not pending)
+                        if (ckpt_manager is not None and ckpt_interval
+                                and (k + 1 - start_episode) % ckpt_interval
+                                == 0 and guard.last_good is not None
+                                and guard.last_good[0] == k):
+                            # the promoted snapshot IS the verified state
+                            # after episode k — exactly what a resumable
+                            # checkpoint must contain (the live carries
+                            # may already be an episode ahead)
+                            _, g_state, g_buffer = guard.last_good
+                            ckpt_manager.save(g_state, g_buffer,
+                                              episode=k + 1)
+                    return
+                if guard is None:
+                    self._recover(
+                        k, site="learner_state", action="detected",
+                        fault="non_finite_state",
+                        detail="rollback disabled (Trainer(rollback="
+                               "False)) — continuing with the poisoned "
+                               "state")
+                    self._last_drained = max(self._last_drained, k)
+                    return
+                dropped = [e[0] for e in pending]
+                pending.clear()
+                tag, state, buffer = guard.restore()
+                self._recover(
+                    k, site="learner_state", action="rollback",
+                    fault="non_finite_state",
+                    detail=f"restored snapshot of episode {tag}; skipped "
+                           f"poisoned episode {k}"
+                           + (f"; dropped in-flight {dropped}"
+                              if dropped else ""))
+
             for ep in range(start_episode, episodes):
+                if preempt is not None and preempt.triggered:
+                    self.preempted = True
+                    self._recover(
+                        ep, site="run", action="preempt_snapshot",
+                        fault=preempt.signame,
+                        detail=f"stopping before episode {ep}; in-flight "
+                               "episodes drain, then the caller "
+                               "checkpoints")
+                    break
                 if ep > start_episode:
                     topo, traffic = next_episode(ep)
                     env_state, obs = self.env.reset(
@@ -312,21 +590,28 @@ class Trainer:
                 end_step = global_step + steps_per_ep - 1
                 learn = (end_step
                          >= self.agent_cfg.nb_steps_warmup_critic - 1)
-                with phase_span("dispatch", timer, hub), episode_span(ep):
-                    if pipeline:
-                        (state, buffer, env_state, obs, stats,
-                         learn_metrics) = self.ddpg.episode_step(
-                            state, buffer, env_state, obs, topo, traffic,
-                            np.int32(global_step), learn=learn)
-                    else:
-                        (state, buffer, env_state, obs,
-                         stats) = self.ddpg.rollout_episode(
-                            state, buffer, env_state, obs, topo, traffic,
-                            np.int32(global_step))
-                        learn_metrics = None
-                        if learn:
-                            state, learn_metrics = self.ddpg.learn_burst(
-                                state, buffer)
+                if guard is not None:
+                    # candidate snapshot at the dispatch boundary: the
+                    # state after episode ep-1, not yet verified (its
+                    # finite flag drains one episode later under the
+                    # pipeline) — promote() gates it.  Taken BEFORE the
+                    # fault injection below so an injected poison can
+                    # never be promoted, and copied so the dispatch's
+                    # donation cannot invalidate it.
+                    guard.stage(ep - 1, state, buffer)
+                if plan is not None:
+                    spec = plan.fire("nan_grads", ep)
+                    if spec is not None:
+                        # the effect of a NaN gradient update: the state
+                        # entering this episode is poisoned; the
+                        # on-device flag catches it at this episode's
+                        # drain
+                        state = state.replace(
+                            actor_params=poison_tree(state.actor_params))
+                (state, buffer, env_state, obs, stats,
+                 learn_metrics) = self._dispatch_with_retry(
+                    ep, pipeline, state, buffer, env_state, obs, topo,
+                    traffic, global_step, learn, timer, hub)
                 if self.obs:
                     self.obs.episode_dispatched(ep)
                 # the retained arrays (stats, learn metrics, the truncation
@@ -339,19 +624,20 @@ class Trainer:
                                 env_state.sim.truncated_arrivals,
                                 env_state.sim, topo, replay_bytes))
                 while len(pending) > max_pending:
-                    self._drain(pending.pop(0), start, start_episode,
-                                verbose, timer)
+                    drain_one()
             while pending:
                 # happy-path tail drain stays INSIDE the try: an async
                 # device fault surfacing at the final episode's sync must
                 # raise like the serial loop would, not be downgraded
-                self._drain(pending.pop(0), start, start_episode, verbose,
-                            timer)
+                drain_one()
         finally:
             if self.obs:
                 # disarm BEFORE the best-effort teardown drains — a fault
                 # recovery path must not also spray stall events
                 self.obs.pause_watchdog()
+                if self.obs.watchdog is not None:
+                    self.obs.watchdog.on_escalate = None
+            self._live_prefetch = None
             # only nonempty when an exception is already propagating:
             # flush completed episodes' rows into rewards.csv exactly as
             # the serial loop would have written them before the fault.
@@ -368,6 +654,13 @@ class Trainer:
                     break
             if prefetch is not None:
                 prefetch.close()
+        self.completed_episodes = self._last_drained + 1
+        if plan is not None and plan.unfired():
+            # a mis-keyed plan (episode index past the run's end, a site
+            # the run shape never reaches) must be loud: a chaos test
+            # whose fault never fired proves nothing
+            log.warning("fault plan entries never fired: %s",
+                        [f"{s.site}@{s.episode}" for s in plan.unfired()])
         if verbose:
             log.info("pipeline phase timings: %s", timer.summary())
         self.rewards_writer.close()
@@ -379,7 +672,9 @@ class Trainer:
                        chunk: int = 50, verbose: bool = False,
                        device_traffic: bool = True, profile: bool = False,
                        init_state: Optional[DDPGState] = None,
-                       init_buffers=None, start_episode: int = 0):
+                       init_buffers=None, start_episode: int = 0,
+                       ckpt_manager=None, ckpt_interval: int = 0,
+                       preempt=None):
         """Replica-parallel training: B vmapped env replicas per episode on
         the scheduled topology, chunked rollouts + end-of-episode learn
         burst (the bench/learning-curve path), logged through the same
@@ -389,7 +684,19 @@ class Trainer:
 
         The reference has no analogue (one process, one env); evaluation
         and checkpointing consume the resulting learner state exactly like
-        the single-env path's."""
+        the single-env path's.
+
+        Resilience on this path: preemption stop + periodic checkpoints
+        (finite-verified host-side — there is no rollback guard here);
+        fault injection is NOT wired through the replica harness, so a
+        fault plan is refused up front rather than silently ignored."""
+        if self.fault_plan is not None:
+            # a chaos plan that never fires would make a replica run look
+            # exercised while proving nothing — refuse before any setup
+            raise ValueError(
+                "--fault-plan is not supported on the replica-parallel "
+                "path (train_parallel has no injection sites or rollback "
+                "guard); run the chaos plan with --replicas 1")
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
@@ -398,7 +705,10 @@ class Trainer:
                                            profile=False,
                                            init_state=init_state,
                                            init_buffers=init_buffers,
-                                           start_episode=start_episode)
+                                           start_episode=start_episode,
+                                           ckpt_manager=ckpt_manager,
+                                           ckpt_interval=ckpt_interval,
+                                           preempt=preempt)
         from ..parallel import ParallelDDPG
         from ..parallel.harness import run_chunked_episodes
         from ..sim.traffic_device import DeviceTraffic
@@ -453,6 +763,8 @@ class Trainer:
 
         self.phase_timer = timer = PhaseTimer()
         hub = self.obs.hub if self.obs else None
+        self.preempted = False
+        self._last_drained = start_episode - 1
         if self.obs:
             self.obs.resume_watchdog()
         start = time.time()
@@ -463,6 +775,14 @@ class Trainer:
             # sees one continuous run (and a resumed run continues it
             # exactly)
             for ep in range(start_episode, episodes):
+                if preempt is not None and preempt.triggered:
+                    self.preempted = True
+                    self._recover(
+                        ep, site="run", action="preempt_snapshot",
+                        fault=preempt.signame,
+                        detail=f"stopping before episode {ep}; the caller "
+                               "checkpoints the drained state")
+                    break
                 topo = self.driver.topology_for(ep)
                 traffic = episode_traffic(ep, topo)
                 if self.obs:
@@ -494,9 +814,33 @@ class Trainer:
                         sps=sps, phases=timer.summary(),
                         replay_bytes=buffer_nbytes(buffers),
                         extra={"replicas": num_replicas})
+                self._last_drained = ep
+                if (ckpt_manager is not None and ckpt_interval
+                        and (ep + 1 - start_episode) % ckpt_interval == 0):
+                    # the replica harness drains synchronously, so the
+                    # live carries ARE the state after episode ep — but
+                    # with no rollback guard on this path the state must
+                    # be verified HERE, or a NaN-poisoned run would
+                    # checksum garbage into the last-good resume target.
+                    # One host-side scan at checkpoint cadence (the orbax
+                    # save syncs these leaves anyway).
+                    if all(np.isfinite(np.asarray(leaf)).all()
+                           for leaf in jax.tree_util.tree_leaves(state)
+                           if np.issubdtype(np.asarray(leaf).dtype,
+                                            np.inexact)):
+                        ckpt_manager.save(state, buffers, episode=ep + 1)
+                    else:
+                        self._recover(
+                            ep, site="learner_state", action="detected",
+                            fault="non_finite_state",
+                            detail="replica path has no rollback guard — "
+                                   "checkpoint skipped so the last-good "
+                                   "pointer keeps the previous verified "
+                                   "state")
         finally:
             if self.obs:
                 self.obs.pause_watchdog()
+        self.completed_episodes = self._last_drained + 1
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()

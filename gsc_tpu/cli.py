@@ -184,7 +184,11 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--resume", default=None,
               help="checkpoint dir from a previous train run: restores "
                    "params+opt+targets+replay+PRNG and continues exactly "
-                   "(total episode count still set by --episodes)")
+                   "(total episode count still set by --episodes).  "
+                   "'auto' searches --result-dir for the newest checkpoint "
+                   "whose content checksum validates (periodic/preemption "
+                   "saves and final checkpoints all qualify), falling back "
+                   "past corrupted ones")
 @click.option("--resource-functions-path", default=None,
               help="dir (or .py file) of user resource-function plugins "
                    "to register before parsing the service catalog "
@@ -227,17 +231,44 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
               help="seconds without a completed episode before the "
                    "pipeline watchdog emits a structured 'stall' event "
                    "(0 disables the watchdog)")
+@click.option("--watchdog-escalate", default=3, show_default=True,
+              help="after the first stall, this many MORE full "
+                   "--watchdog-budget periods of continued silence "
+                   "escalate from reporting to acting: the watchdog "
+                   "interrupts the prefetcher and the trainer restarts it "
+                   "from the episode counter (0 = report-only)")
 @click.option("--check-invariants/--no-check-invariants", default=False,
               show_default=True,
               help="run utils.debug.check_invariants on every drained "
                    "episode's final simulator state; violations emit "
                    "structured 'invariant_violation' events")
+@click.option("--fault-plan", default=None,
+              help="deterministic fault injection for chaos testing "
+                   "(resilience.FaultPlan grammar: 'site@episode[:arg]' "
+                   "joined by ';', sites: prefetch_die, slow_episode, "
+                   "dispatch_transient, nan_grads, ckpt_corrupt).  "
+                   "Unset: the GSC_FAULT_PLAN env var; empty = no faults")
+@click.option("--rollback/--no-rollback", default=True, show_default=True,
+              help="keep a last-good in-memory snapshot of (state, "
+                   "replay) and roll back when the on-device all-finite "
+                   "guard flags a poisoned learner state (costs ~2 extra "
+                   "replay copies in HBM; training math is bit-identical "
+                   "until a violation actually triggers)")
+@click.option("--ckpt-interval", default=0, show_default=True,
+              help="episodes between preemption-safe checkpoints "
+                   "(checksummed, written under <run>/ckpts with a "
+                   "rotating last-good pointer; 0 disables).  SIGTERM/"
+                   "SIGINT always snapshot one on the way out")
+@click.option("--ckpt-retain", default=3, show_default=True,
+              help="periodic checkpoints kept on disk (the last-good "
+                   "pointer target is never pruned)")
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
           pipeline, precision, obs_enabled, obs_dir, obs_interval,
-          watchdog_budget, check_invariants, verbose):
+          watchdog_budget, watchdog_escalate, check_invariants, fault_plan,
+          rollback, ckpt_interval, ckpt_retain, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -257,9 +288,33 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
 
     if resume and runs != 1:
         raise click.BadParameter("--resume only supports --runs 1")
+    if resume == "auto":
+        # newest checksummed checkpoint under the result root that still
+        # validates — a corrupted newest (half-written at the kill, bit
+        # rot) falls back to the previous good one
+        from .resilience.ckpt import find_resumable
+        found = find_resumable(result_dir)
+        if not found:
+            raise click.BadParameter(
+                "--resume auto: no checkpoint with a validating content "
+                f"checksum under {result_dir!r} (periodic --ckpt-interval "
+                "saves, preemption snapshots and final checkpoints all "
+                "qualify)")
+        click.echo(f"[resume auto] {found}", err=True)
+        resume = found
+    # deterministic chaos schedule (--fault-plan / GSC_FAULT_PLAN env);
+    # parse errors must fail the command before any run state exists.
+    # Parsed FRESH per run below — FaultPlan specs fire exactly once, so
+    # one shared object would leave runs 1..N-1 silently fault-free.
+    from .resilience.faults import FaultPlan
+    try:
+        FaultPlan.from_env(fault_plan)
+    except ValueError as e:
+        raise click.BadParameter(str(e))
     run_dirs = []
     outputs = {}
     for run in range(runs):
+        plan = FaultPlan.from_env(fault_plan)
         run_seed = seed + run
         if resume:
             # the checkpoint records the precision it was trained under
@@ -310,14 +365,28 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                 odir = os.path.join(obs_dir, f"run{run}")
             obs = RunObserver(odir, snapshot_interval=obs_interval,
                               watchdog_budget_s=watchdog_budget,
+                              watchdog_escalate=watchdog_escalate,
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
                             "precision": agent.precision,
-                            "result_dir": rdir})
+                            "result_dir": rdir,
+                            "ckpt_interval": ckpt_interval,
+                            **({"fault_plan": plan.summary()} if plan
+                               else {})})
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
                           tensorboard=tensorboard, obs=obs,
-                          check_invariants=check_invariants)
+                          check_invariants=check_invariants,
+                          fault_plan=plan, rollback=rollback)
+        # checksummed rotating checkpoints under the run dir: periodic
+        # (--ckpt-interval) and the SIGTERM/SIGINT snapshot both land
+        # here, which is exactly the tree --resume auto searches
+        from .resilience.ckpt import CheckpointManager
+        from .resilience.preempt import PreemptionGuard
+        manager = CheckpointManager(os.path.join(rdir, "ckpts"),
+                                    retain=ckpt_retain,
+                                    meta={"precision": agent.precision},
+                                    fault_plan=plan, obs=obs)
         try:
             # everything from here on runs under the observer: a failed
             # resume restore (or bad --episodes) must still land the
@@ -363,25 +432,51 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                         f"checkpoint's completed episode count "
                         f"({start_episode})")
             result.runtime_start("train")
-            if replicas > 1:
-                state, buffer = trainer.train_parallel(
-                    episodes, num_replicas=replicas, chunk=chunk,
-                    verbose=verbose, profile=profile, init_state=init_state,
-                    init_buffers=init_buffer, start_episode=start_episode)
-            else:
-                state, buffer = trainer.train(episodes, verbose=verbose,
-                                              profile=profile,
-                                              init_state=init_state,
-                                              init_buffer=init_buffer,
-                                              start_episode=start_episode,
-                                              pipeline=pipeline)
+            # SIGTERM/SIGINT during training stop the loop at the next
+            # episode boundary; the snapshot + clean exit happen below
+            with PreemptionGuard() as guard:
+                if replicas > 1:
+                    state, buffer = trainer.train_parallel(
+                        episodes, num_replicas=replicas, chunk=chunk,
+                        verbose=verbose, profile=profile,
+                        init_state=init_state, init_buffers=init_buffer,
+                        start_episode=start_episode,
+                        ckpt_manager=manager, ckpt_interval=ckpt_interval,
+                        preempt=guard)
+                else:
+                    state, buffer = trainer.train(
+                        episodes, verbose=verbose, profile=profile,
+                        init_state=init_state, init_buffer=init_buffer,
+                        start_episode=start_episode, pipeline=pipeline,
+                        ckpt_manager=manager, ckpt_interval=ckpt_interval,
+                        preempt=guard)
             result.runtime_stop("train")
+
+            if trainer.preempted:
+                # preemption-safe exit: a checksummed snapshot of the
+                # drained state (monotone episode counter), a clean rc=0,
+                # and a JSON line saying how to continue — no evaluation,
+                # the grace window is for the checkpoint
+                done = trainer.completed_episodes
+                ckpt = manager.save(state, buffer, episode=done)
+                if obs is not None:
+                    obs.close(status="preempted")
+                result.metrics = {"status": "preempted"}
+                result.write()
+                click.echo(json.dumps({
+                    "status": "preempted", "signal": guard.signame,
+                    "result_dir": rdir, "checkpoint": ckpt,
+                    "episodes_completed": done,
+                    "hint": "continue with --resume auto"}))
+                return
 
             ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,
                                    buffer=buffer,
                                    extra={"episode": _np.asarray(episodes,
                                                                  _np.int32)},
-                                   meta={"precision": agent.precision})
+                                   meta={"precision": agent.precision,
+                                         "episode": episodes},
+                                   checksum=True)
             result.runtime_start("test")
             test = trainer.evaluate(state, episodes=1, test_mode=True,
                                     telemetry=True)

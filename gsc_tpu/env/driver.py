@@ -90,17 +90,27 @@ class EpisodeDriver:
 
     def prefetcher(self, start: int, stop: int, test_mode: bool = False,
                    depth: int = 2, stage: Optional[Callable] = None,
-                   heartbeat: Optional[Callable] = None
+                   heartbeat: Optional[Callable] = None,
+                   before_episode: Optional[Callable] = None
                    ) -> "EpisodePrefetcher":
         """Background double buffer over ``episode``: episode k+1's traffic
         is sampled (and optionally staged to device via ``stage``) while
         episode k's rollout runs on the accelerator.  ``heartbeat`` (e.g.
         the obs hub's prefetcher beat) is called from the producer thread
         after every staged episode so a watchdog can tell a dead producer
-        from one blocked on a full queue."""
+        from one blocked on a full queue.  ``before_episode(ep,
+        stop_event)`` runs in the producer before each episode's sampling
+        — the resilience fault-injection hook (prefetcher death, slow
+        episodes)."""
         return EpisodePrefetcher(self, start, stop, test_mode=test_mode,
                                  depth=depth, stage=stage,
-                                 heartbeat=heartbeat)
+                                 heartbeat=heartbeat,
+                                 before_episode=before_episode)
+
+
+class PrefetchInterrupted(RuntimeError):
+    """The prefetcher was deliberately interrupted (watchdog escalation) —
+    the consumer should restart it from the current episode counter."""
 
 
 class EpisodePrefetcher:
@@ -126,13 +136,16 @@ class EpisodePrefetcher:
     def __init__(self, driver: EpisodeDriver, start: int, stop: int,
                  test_mode: bool = False, depth: int = 2,
                  stage: Optional[Callable] = None,
-                 heartbeat: Optional[Callable] = None):
+                 heartbeat: Optional[Callable] = None,
+                 before_episode: Optional[Callable] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.driver = driver
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
         self._stop_flag = threading.Event()
-        self._args = (start, stop, test_mode, stage, heartbeat)
+        self._interrupted: Optional[str] = None
+        self._args = (start, stop, test_mode, stage, heartbeat,
+                      before_episode)
         self._thread = threading.Thread(
             target=self._produce, name="gsc-episode-prefetch", daemon=True)
         self._thread.start()
@@ -147,9 +160,16 @@ class EpisodePrefetcher:
         return self._thread.is_alive()
 
     def _produce(self):
-        start, stop, test_mode, stage, heartbeat = self._args
+        start, stop, test_mode, stage, heartbeat, before_episode = self._args
         try:
             for ep in range(start, stop):
+                if before_episode is not None:
+                    # fault-injection hook; receives the stop flag so an
+                    # injected slow-stage sleep aborts the moment close()
+                    # abandons this producer
+                    before_episode(ep, self._stop_flag)
+                if self._stop_flag.is_set():
+                    return
                 item = self.driver.episode(ep, test_mode)
                 if stage is not None:
                     item = stage(*item)
@@ -169,11 +189,31 @@ class EpisodePrefetcher:
         else:
             self._queue.put((self._DONE, None))
 
+    def interrupt(self, reason: str):
+        """Fail the consumer's next (or currently-blocked) ``get`` with a
+        :class:`PrefetchInterrupted` — the watchdog's escalation path:
+        called from the watchdog thread when the pipeline has been quiet
+        past its escalation budget, so the trainer wakes out of a blocked
+        ``get`` and restarts the prefetcher.  The producer itself is left
+        to ``close()``."""
+        self._interrupted = reason
+        try:   # wake a consumer blocked on an empty queue; a full queue
+            # means the consumer isn't blocked here and the flag check in
+            # get() suffices
+            self._queue.put_nowait((self._ERROR,
+                                    PrefetchInterrupted(reason)))
+        except queue.Full:
+            pass
+
     def get(self, episode: int):
         """(topo, traffic) for ``episode`` — episodes must be consumed in
         the order the prefetcher was built for."""
+        if self._interrupted is not None:
+            raise PrefetchInterrupted(self._interrupted)
         tag, item = self._queue.get()
         if tag == self._ERROR:
+            if isinstance(item, PrefetchInterrupted):
+                raise item
             raise RuntimeError(
                 "episode prefetch thread failed") from item
         if tag == self._DONE:

@@ -28,7 +28,8 @@ class RunObserver:
                  snapshot_interval: int = 10,
                  watchdog_budget_s: float = 0.0,
                  tags: Optional[Dict[str, object]] = None,
-                 compile_events: bool = True):
+                 compile_events: bool = True,
+                 watchdog_escalate: int = 0):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
@@ -44,8 +45,12 @@ class RunObserver:
             # stall.  (First-dispatch jit compile happens INSIDE the loop
             # and does count: a stall with episodes_drained=0 carries a
             # note saying compile may dominate it.)
+            # escalation (``watchdog_escalate`` extra quiet periods before
+            # acting) stays report-only until the trainer installs its
+            # ``on_escalate`` hook for the duration of the episode loop
             self.watchdog = PipelineWatchdog(self.hub, watchdog_budget_s,
-                                             start_paused=True)
+                                             start_paused=True,
+                                             escalate_after=watchdog_escalate)
         # retrace sentinel (analysis.sentinels.CompileMonitor): counts jit
         # traces / XLA compiles per watched entry point and emits one
         # `compile` event per occurrence into events.jsonl — a retrace
@@ -84,7 +89,9 @@ class RunObserver:
         try:
             self.hub.event("run_end", status=status,
                            episodes=self._drained,
-                           stalls=self.hub.get_counter("stalls"))
+                           stalls=self.hub.get_counter("stalls"),
+                           recoveries=self.hub.get_counter(
+                               "recoveries_total"))
             self.write_snapshot()
         finally:
             self.hub.close()
@@ -191,6 +198,26 @@ class RunObserver:
         if self._drained % self.snapshot_interval == 0:
             self.write_snapshot()
         return record
+
+    def recovery(self, episode: int, site: str, action: str,
+                 fault: Optional[str] = None,
+                 attempt: Optional[int] = None,
+                 detail: Optional[str] = None) -> Dict:
+        """One self-healing action (resilience subsystem): a monotonic
+        total plus a per-(site, action) counter for metrics.json diffs,
+        and one structured ``recovery`` event in events.jsonl —
+        ``tools/obs_report.py`` renders them as the recovery timeline.
+
+        The degradation ladder's actions: ``retry`` (dispatch backoff),
+        ``restart`` (prefetcher), ``pipeline_off`` (degrade to serial
+        sampling), ``rollback`` (restore last-good state), ``resave``
+        (checkpoint failed validation), ``preempt_snapshot`` (SIGTERM)."""
+        self.hub.counter("recoveries_total")
+        self.hub.counter("recoveries", site=site, action=action)
+        return self.hub.event(
+            "recovery", episode=episode, site=site, action=action,
+            **{k: v for k, v in (("fault", fault), ("attempt", attempt),
+                                 ("detail", detail)) if v is not None})
 
     def invariant_violation(self, episode: int, violations: List[str]):
         """Route a simulator-invariant failure through the same structured

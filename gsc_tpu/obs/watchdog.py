@@ -34,12 +34,23 @@ class PipelineWatchdog:
     def __init__(self, hub: MetricsHub, budget_s: float,
                  beat_name: str = "episode",
                  poll_s: Optional[float] = None,
-                 start_paused: bool = False):
+                 start_paused: bool = False,
+                 escalate_after: int = 0,
+                 on_escalate: Optional[Callable[[float], None]] = None):
         if budget_s <= 0:
             raise ValueError(f"watchdog budget must be > 0, got {budget_s}")
         self.hub = hub
         self.budget_s = float(budget_s)
         self.beat_name = beat_name
+        # escalation (resilience): after the first stall, ``escalate_after``
+        # MORE full budget periods of continued silence move the watchdog
+        # from reporting to acting — ``on_escalate(age_s)`` fires ONCE per
+        # stall episode (re-armed with the stall flag by the next
+        # heartbeat).  The trainer wires a prefetcher interrupt/restart
+        # into it; 0 disables escalation (report-only, the PR 2 behavior).
+        self.escalate_after = max(int(escalate_after), 0)
+        self.on_escalate = on_escalate
+        self._escalated = False
         # poll fast enough to flag a stall well inside one extra budget
         # interval, but never busier than 4 Hz
         self.poll_s = poll_s if poll_s is not None else max(
@@ -72,6 +83,7 @@ class PipelineWatchdog:
         so paused time never counts toward the budget."""
         self.hub.beat(self.beat_name)
         self._stalled = False
+        self._escalated = False
         self._stalled_at_beat = None
         self._paused.clear()
 
@@ -99,11 +111,35 @@ class PipelineWatchdog:
             if self._stalled and \
                     self.hub.beat_time(self.beat_name) != self._stalled_at_beat:
                 self._stalled = False
+                self._escalated = False
             if age > self.budget_s and not self._stalled:
                 self._stalled = True
                 self._stalled_at_beat = self.hub.beat_time(self.beat_name)
                 self.stall_count += 1
                 self._emit_stall(age)
+            if (self._stalled and not self._escalated
+                    and self.escalate_after > 0
+                    and age > self.budget_s * (1 + self.escalate_after)):
+                self._escalated = True
+                self._escalate(age)
+
+    def _escalate(self, age: float):
+        """The stall outlived ``escalate_after`` extra budget periods: act.
+        The callback runs on this (watchdog) thread and must only poke
+        thread-safe handles — the trainer's hook interrupts the prefetcher
+        queue, and the training loop does the actual restart."""
+        cb = self.on_escalate
+        self.hub.counter("watchdog_escalations")
+        self.hub.event(
+            "escalation", age_s=round(age, 3), budget_s=self.budget_s,
+            quiet_periods=self.escalate_after + 1,
+            action="callback" if cb is not None else "none")
+        if cb is not None:
+            try:
+                cb(age)
+            except Exception as e:   # an escalation that faults must not
+                # kill the monitor thread — the stall evidence survives
+                self.hub.event("escalation_error", error=repr(e))
 
     def _emit_stall(self, age: float):
         phase, done = self.hub.last_phase

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from ..agents.buffer import (ReplayBuffer, buffer_add, flatten_transition,
                              restore_batch, transition_shapes)
 from ..agents.ddpg import DDPG, DDPGState, donated_jit
+from ..resilience.guard import all_finite
 from ..config.schema import AgentConfig
 from ..env.actions import action_mask
 from ..env.env import ServiceCoordEnv
@@ -180,6 +181,11 @@ class ParallelDDPG:
             # hub tags replica-resolved gauges from them (a collapsing
             # replica is invisible in the cross-replica mean)
             "per_replica_return": stats["reward"].sum(0),
+            # divergence guardrail over the (replicated) learner state
+            # entering the chunk — same contract as DDPG._rollout_body;
+            # the post-update flag rides in the learn metrics via the
+            # shared _learn_burst
+            "state_finite": all_finite(state),
         }
         return (state.replace(rng=rng), buffers, env_states, obs,
                 episode_stats)

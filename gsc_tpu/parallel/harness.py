@@ -102,11 +102,23 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
             if buffers is not None and hasattr(buffers, "size"):
                 for r, fill in enumerate(np.asarray(buffers.size).tolist()):
                     hub.gauge("replica_replay_fill", fill, replica=str(r))
+            # divergence-guard verdict for the episode: the rollout flags
+            # (state entering each chunk) AND the learn burst's post-update
+            # flag — all device scalars already synced by the drain above;
+            # absent on fakes/legacy stats (None, not a false alarm)
+            finite = None
+            flags = [s["state_finite"] for s in chunk_stats
+                     if "state_finite" in s]
+            if metrics is not None and "state_finite" in metrics:
+                flags.append(metrics["state_finite"])
+            if flags:
+                finite = bool(min(float(f) for f in flags) > 0)
             hub.event("harness_episode", episode=global_ep,
                       episodic_return=returns[-1],
                       mean_succ_ratio=succ[-1],
                       final_succ_ratio=final_succ[-1],
-                      per_replica_return=rep_returns)
+                      per_replica_return=rep_returns,
+                      state_finite=finite)
         if on_episode is not None:
             on_episode(ep, returns[-1], succ[-1], metrics)
     return state, buffers, returns, succ, final_succ

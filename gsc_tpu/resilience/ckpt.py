@@ -1,0 +1,200 @@
+"""Preemption-safe checkpointing: checksums, rotation, last-good pointer.
+
+``cli train --ckpt-interval N`` snapshots the verified last-good learner
+state + replay every N episodes through a :class:`CheckpointManager`:
+
+- every save carries a content checksum in its ``.meta.json`` sidecar
+  (``utils.checkpoint.checkpoint_checksum``) and is re-validated before
+  the pointer moves.  The checksum is derived from the bytes orbax wrote,
+  so what it proves is that the checkpoint READ BACK equals what was
+  recorded: the post-save check catches damage landing between write and
+  pointer update (and the injected ``ckpt_corrupt`` fault) and re-saves
+  once; the real protection is at RESUME time, where truncation, bit rot
+  or a half-finished save from a killed process fails validation and
+  falls back — a writer that serialized garbage in the first place is
+  out of scope (that is what the in-memory rollback guard's verified
+  snapshots are for);
+- ``last_good.json`` is an atomically-rewritten pointer to the newest
+  VALIDATED checkpoint;
+- retention keeps the newest ``retain`` checkpoints (the pointer target is
+  never pruned), so a long run cannot fill the disk.
+
+``--resume auto`` (:func:`find_resumable`) walks a result tree for
+checksummed sidecars, newest-episode first, and returns the first
+checkpoint whose checksum still validates — falling back past a corrupted
+newest checkpoint to the previous good one.
+
+NOTE: importing this module pulls in the orbax/agents stack; it is
+deliberately NOT re-exported from ``gsc_tpu.resilience`` (see the package
+docstring).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.sinks import write_atomic_json
+from ..utils.checkpoint import (read_checkpoint_meta, save_checkpoint,
+                                verify_checkpoint)
+
+log = logging.getLogger("gsc_tpu.resilience.ckpt")
+
+POINTER_NAME = "last_good.json"
+_META_SUFFIX = ".meta.json"
+
+
+def corrupt_checkpoint(path: str) -> Optional[str]:
+    """Truncate the largest file under an on-disk checkpoint to half its
+    size — the ``ckpt_corrupt`` fault's disk damage (also what a
+    mid-preemption kill of a non-atomic writer leaves behind).  Returns
+    the damaged file's path, or None when there was nothing to damage."""
+    target, target_size = None, -1
+    for root, _, files in os.walk(path):
+        for name in files:
+            fp = os.path.join(root, name)
+            size = os.path.getsize(fp)
+            if size > target_size:
+                target, target_size = fp, size
+    if target is None:
+        return None
+    with open(target, "r+b") as f:
+        f.truncate(max(target_size // 2, 1))
+    return target
+
+
+class CheckpointManager:
+    """Rotating checksummed checkpoints under one root directory.
+
+    ``save`` writes ``<root>/ep<episode>``, validates the written bytes,
+    re-saves once on validation failure (emitting a ``recovery`` event
+    through ``obs``), updates the ``last_good.json`` pointer and prunes
+    beyond ``retain``.  ``fault_plan`` wires the ``ckpt_corrupt``
+    injection site."""
+
+    def __init__(self, root: str, retain: int = 3,
+                 meta: Optional[dict] = None, fault_plan=None, obs=None):
+        self.root = os.path.abspath(root)
+        self.retain = max(int(retain), 1)
+        self.meta = dict(meta or {})
+        self.fault_plan = fault_plan
+        self.obs = obs
+
+    def _path(self, episode: int) -> str:
+        return os.path.join(self.root, f"ep{int(episode):08d}")
+
+    @property
+    def pointer_path(self) -> str:
+        return os.path.join(self.root, POINTER_NAME)
+
+    def save(self, state, buffer, episode: int) -> Optional[str]:
+        """Checkpoint ``episode`` completed episodes; returns the path on
+        success, None when even the re-save failed validation (the pointer
+        then still names the previous good checkpoint)."""
+        os.makedirs(self.root, exist_ok=True)
+        path = self._path(episode)
+
+        # NOT named `write`: gsc-lint resolves call edges by bare name, and
+        # half the traced codebase calls `.write(...)` — a closure named
+        # `write` here would drag this whole host-side module into the
+        # jit-reachability set and flag its int()/os calls as host syncs
+        def write_ckpt():
+            return save_checkpoint(
+                path, state, buffer=buffer,
+                extra={"episode": np.asarray(episode, np.int32)},
+                meta={**self.meta, "episode": int(episode)}, checksum=True)
+
+        write_ckpt()
+        if self.fault_plan is not None:
+            spec = self.fault_plan.fire("ckpt_corrupt", episode,
+                                        at_or_after=True)
+            if spec is not None:
+                damaged = corrupt_checkpoint(path)
+                log.warning("fault ckpt_corrupt: damaged %s", damaged)
+        if not verify_checkpoint(path):
+            # a corrupted write must never become the resume target: say
+            # so (structured), and re-save once — disk-full or a genuinely
+            # broken writer fails again and keeps the previous pointer
+            if self.obs is not None:
+                self.obs.recovery(episode=episode, site="checkpoint",
+                                  fault="checksum_mismatch",
+                                  action="resave",
+                                  detail=f"validation failed for {path}; "
+                                         "rewriting once")
+            else:
+                log.warning("checkpoint %s failed checksum validation — "
+                            "re-saving once", path)
+            write_ckpt()
+            if not verify_checkpoint(path):
+                log.error("checkpoint %s failed validation twice — "
+                          "keeping previous last-good pointer", path)
+                return None
+        write_atomic_json(self.pointer_path, {
+            "path": path, "episode": int(episode),
+            "checksum": read_checkpoint_meta(path).get("checksum")})
+        self._prune(keep=path)
+        return path
+
+    def _prune(self, keep: str):
+        """Drop all but the newest ``retain`` checkpoints (and never the
+        pointer target / just-written one)."""
+        entries: List[Tuple[int, str]] = []
+        for name in os.listdir(self.root):
+            full = os.path.join(self.root, name)
+            if name.startswith("ep") and os.path.isdir(full):
+                try:
+                    entries.append((int(name[2:]), full))
+                except ValueError:
+                    continue
+        entries.sort(reverse=True)
+        for _, full in entries[self.retain:]:
+            if os.path.abspath(full) == os.path.abspath(keep):
+                continue
+            shutil.rmtree(full, ignore_errors=True)
+            try:
+                os.unlink(full + _META_SUFFIX)
+            except OSError:
+                pass
+
+    def latest_valid(self) -> Optional[str]:
+        return find_resumable(self.root)
+
+
+def find_resumable(search_root: str) -> Optional[str]:
+    """Newest checkpoint under ``search_root`` (recursive) whose content
+    checksum validates — the ``--resume auto`` resolver.
+
+    Candidates are directories with a ``.meta.json`` sidecar carrying a
+    ``checksum`` field (periodic saves, preemption snapshots, and final
+    ``cli train`` checkpoints all qualify), ordered newest first by the
+    sidecar's recorded episode then mtime.  An invalid candidate (damaged
+    bytes, stale sidecar) is logged and skipped — the previous good one
+    wins."""
+    search_root = os.path.abspath(search_root)
+    candidates: List[Tuple[int, float, str]] = []
+    for root, _, files in os.walk(search_root):
+        for name in files:
+            if not name.endswith(_META_SUFFIX):
+                continue
+            sidecar = os.path.join(root, name)
+            ckpt = sidecar[:-len(_META_SUFFIX)]
+            meta = read_checkpoint_meta(ckpt)
+            if not meta.get("checksum") or not os.path.isdir(ckpt):
+                continue
+            try:
+                mtime = os.path.getmtime(sidecar)
+            except OSError:
+                continue
+            candidates.append((int(meta.get("episode", -1)), mtime, ckpt))
+    for episode, _, ckpt in sorted(candidates, reverse=True):
+        if verify_checkpoint(ckpt):
+            log.info("resume auto: %s (episode %d) validates", ckpt,
+                     episode)
+            return ckpt
+        log.warning("resume auto: %s failed checksum validation — "
+                    "falling back to the previous checkpoint", ckpt)
+    return None

@@ -1,0 +1,168 @@
+"""Deterministic fault injection: the ``FaultPlan`` grammar and firing.
+
+Production RL systems treat worker failure as normal operation (Podracer,
+arXiv:2104.06272) and co-design the training loop with the platform's
+failure modes (MindSpeed RL, arXiv:2507.19017) — but a recovery path that
+has never executed is a recovery path that does not work.  A ``FaultPlan``
+injects *named* faults at *named* sites keyed by episode index, so every
+self-healing path in the trainer has a test (and a CI chaos stage) that
+actually exercises it:
+
+==================== =====================================================
+site                 effect when the keyed episode is reached
+==================== =====================================================
+``prefetch_die``     the episode prefetcher's producer thread raises while
+                     staging the keyed episode (surfaced on the consumer's
+                     next ``get``; the trainer restarts the prefetcher)
+``slow_episode``     the producer sleeps ``arg`` seconds (default 1.0)
+                     before staging the keyed episode — long enough to trip
+                     the watchdog, whose escalation interrupts/restarts the
+                     prefetcher (the sleep aborts early on prefetcher stop)
+``dispatch_transient`` episode dispatch raises a transient
+                     ``XlaRuntimeError``-like failure once; the retry layer
+                     backs off and re-dispatches
+``nan_grads``        the learner state entering the keyed episode is
+                     poisoned with NaN (the effect of a NaN gradient
+                     update); the on-device all-finite guard detects it at
+                     drain and the trainer rolls back
+``ckpt_corrupt``     the first periodic checkpoint written at-or-after the
+                     keyed episode is corrupted on disk; checksum
+                     validation catches it and the manager re-saves
+==================== =====================================================
+
+Grammar (``--fault-plan`` / env ``GSC_FAULT_PLAN``)::
+
+    plan  := entry (";" entry)*
+    entry := site "@" episode [":" arg]
+
+e.g. ``prefetch_die@1;nan_grads@3;slow_episode@2:1.5``.  Each entry fires
+exactly ONCE (thread-safe), which is what makes the recovery paths
+convergent: a restarted prefetcher re-staging the same episode does not
+re-hit the fault.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+from typing import List, Optional
+
+log = logging.getLogger("gsc_tpu.resilience.faults")
+
+SITES = ("prefetch_die", "slow_episode", "dispatch_transient", "nan_grads",
+         "ckpt_corrupt")
+
+ENV_VAR = "GSC_FAULT_PLAN"
+
+
+class FaultInjected(RuntimeError):
+    """An injected (non-transient) fault — e.g. the prefetcher producer's
+    death.  Distinct from the transient class so the retry layer never
+    retries a fault that models a hard failure."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    episode: int
+    arg: Optional[float] = None
+    fired_at: Optional[int] = None   # episode the fault actually fired at
+
+    @property
+    def fired(self) -> bool:
+        return self.fired_at is not None
+
+
+class FaultPlan:
+    """Parsed fault schedule; ``fire`` is the single (locked) gate every
+    injection site calls — marking the spec fired so each entry triggers
+    exactly once even across prefetcher restarts and dispatch retries."""
+
+    def __init__(self, specs: List[FaultSpec]):
+        self.specs = list(specs)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        specs = []
+        for raw in text.replace(",", ";").split(";"):
+            raw = raw.strip()
+            if not raw:
+                continue
+            if "@" not in raw:
+                raise ValueError(
+                    f"fault-plan entry {raw!r} is not 'site@episode[:arg]'")
+            site, _, rest = raw.partition("@")
+            site = site.strip()
+            if site not in SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} (expected one of "
+                    f"{', '.join(SITES)})")
+            ep_s, _, arg_s = rest.partition(":")
+            try:
+                episode = int(ep_s)
+            except ValueError:
+                raise ValueError(
+                    f"fault-plan entry {raw!r}: episode {ep_s!r} is not an "
+                    "integer")
+            if episode < 0:
+                raise ValueError(
+                    f"fault-plan entry {raw!r}: episode must be >= 0")
+            arg = None
+            if arg_s:
+                try:
+                    arg = float(arg_s)
+                except ValueError:
+                    raise ValueError(
+                        f"fault-plan entry {raw!r}: arg {arg_s!r} is not a "
+                        "number")
+            specs.append(FaultSpec(site=site, episode=episode, arg=arg))
+        if not specs:
+            raise ValueError(f"empty fault plan {text!r}")
+        return cls(specs)
+
+    @classmethod
+    def from_env(cls, flag: Optional[str] = None) -> Optional["FaultPlan"]:
+        """Plan from an explicit flag value, falling back to the
+        ``GSC_FAULT_PLAN`` environment variable only when no flag was
+        given at all; None when neither is set.  An EXPLICIT empty flag
+        (``--fault-plan ''``) disables injection even under an exported
+        env plan — that is how an operator runs the clean control leg of
+        a chaos comparison."""
+        if flag is not None:
+            text = flag.strip()
+        else:
+            text = os.environ.get(ENV_VAR, "").strip()
+        return cls.parse(text) if text else None
+
+    def fire(self, site: str, episode: int,
+             at_or_after: bool = False) -> Optional[FaultSpec]:
+        """The unfired spec for ``site`` keyed at ``episode`` (exact match,
+        or the oldest spec with ``spec.episode <= episode`` when
+        ``at_or_after`` — checkpoint saves only happen every interval, so
+        an exact key could never land).  Marks the spec fired."""
+        with self._lock:
+            for spec in self.specs:
+                if spec.site != site or spec.fired:
+                    continue
+                if spec.episode == episode or (at_or_after
+                                               and spec.episode <= episode):
+                    spec.fired_at = episode
+                    log.warning("fault injected: %s@%d (fired at episode "
+                                "%d, arg=%s)", site, spec.episode, episode,
+                                spec.arg)
+                    return spec
+        return None
+
+    def summary(self) -> List[dict]:
+        """JSON-able plan description (run_start meta / reports)."""
+        with self._lock:
+            return [{"site": s.site, "episode": s.episode, "arg": s.arg,
+                     "fired": s.fired} for s in self.specs]
+
+    def unfired(self) -> List[FaultSpec]:
+        """Specs that never triggered — a mis-keyed plan (e.g. an episode
+        index past the run's end) should be loud, not silently green."""
+        with self._lock:
+            return [s for s in self.specs if not s.fired]

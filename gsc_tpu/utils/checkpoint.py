@@ -9,8 +9,10 @@ are one orbax checkpoint, so training resumes bit-exactly.
 """
 from __future__ import annotations
 
+import hashlib
 import inspect
 import json
+import logging
 import os
 from typing import Any, Optional
 
@@ -19,6 +21,8 @@ import orbax.checkpoint as ocp
 
 from ..agents.buffer import ReplayBuffer
 from ..agents.ddpg import DDPGState
+
+log = logging.getLogger("gsc_tpu.utils.checkpoint")
 
 # ``partial_restore=`` landed in orbax well after the version this image
 # bakes in (0.7.0 rejects it with a TypeError) — gate on the actual
@@ -36,17 +40,44 @@ def _meta_path(path: str) -> str:
     return os.path.abspath(path).rstrip(os.sep) + ".meta.json"
 
 
+def checkpoint_checksum(path: str) -> str:
+    """Content checksum of an on-disk checkpoint: sha256 over every file
+    under the orbax directory (sorted relative paths + bytes), so a
+    truncated array file, a lost rename, or bit rot all change the digest.
+    Stored in the ``.meta.json`` sidecar by ``save_checkpoint(...,
+    checksum=True)`` and re-derived by :func:`verify_checkpoint`."""
+    path = os.path.abspath(path)
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(path)):
+        dirs.sort()
+        for name in sorted(files):
+            fp = os.path.join(root, name)
+            h.update(os.path.relpath(fp, path).encode())
+            h.update(b"\0")
+            with open(fp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            h.update(b"\0")
+    return h.hexdigest()
+
+
 def save_checkpoint(path: str, state: DDPGState,
                     buffer: Optional[ReplayBuffer] = None,
                     extra: Optional[dict] = None,
-                    meta: Optional[dict] = None) -> str:
+                    meta: Optional[dict] = None,
+                    checksum: bool = False) -> str:
     """Write learner state (+ optional replay buffer + metadata).
 
     ``meta`` is plain-JSON run metadata (e.g. the precision policy name)
     written to a ``<path>.meta.json`` sidecar — config-level facts a
     resume/infer must know BEFORE it can build the restore templates, so
     they cannot live inside the orbax pytree (whose restore already needs
-    correctly-dtyped examples)."""
+    correctly-dtyped examples).
+
+    ``checksum=True`` adds a content checksum of the written checkpoint to
+    the sidecar (creating one even for ``meta=None``) so ``--resume auto``
+    can prove the checkpoint intact before trusting it — the
+    preemption-safe periodic saves always pass it."""
     path = os.path.abspath(path)
     payload = {"state": state}
     if buffer is not None:
@@ -56,6 +87,10 @@ def save_checkpoint(path: str, state: DDPGState,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, payload, force=True)
     ckptr.wait_until_finished()
+    if checksum:
+        meta = dict(meta or {})
+        meta["checksum"] = checkpoint_checksum(path)
+        meta["checksum_algo"] = "sha256-tree"
     if meta is not None:
         # atomic (temp + rename): a crash mid-write must never leave a
         # truncated sidecar that reads back as "pre-meta f32" against a
@@ -74,12 +109,46 @@ def save_checkpoint(path: str, state: DDPGState,
 
 def read_checkpoint_meta(path: str) -> dict:
     """The ``save_checkpoint(meta=...)`` sidecar; {} for checkpoints
-    written before the sidecar existed (implicitly f32, full-f32 replay)."""
+    written before the sidecar existed (implicitly f32, full-f32 replay).
+
+    A truncated/corrupt sidecar (crash mid-write on a pre-atomic-writer
+    install, disk damage, stray edit) degrades to the same {}: resume must
+    never be bricked by a half-written METADATA file when the checkpoint
+    itself is fine — the caller falls back to the implicit-f32 path and a
+    structured warning says why."""
+    meta_path = _meta_path(path)
     try:
-        with open(_meta_path(path)) as f:
-            return json.load(f)
-    except (OSError, json.JSONDecodeError):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
         return {}
+    except (OSError, ValueError, UnicodeDecodeError) as e:
+        # ValueError covers json.JSONDecodeError (truncated/garbled JSON)
+        log.warning(
+            "checkpoint sidecar unreadable — treating as pre-meta "
+            "(implicit f32, no checksum): path=%s error=%s:%s",
+            meta_path, type(e).__name__, e)
+        return {}
+    if not isinstance(meta, dict):
+        log.warning(
+            "checkpoint sidecar is not a JSON object — treating as "
+            "pre-meta: path=%s got=%s", meta_path, type(meta).__name__)
+        return {}
+    return meta
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True iff ``path`` exists and its recomputed content checksum equals
+    the sidecar's recorded one.  False for checkpoints saved without
+    ``checksum=True`` — a checkpoint that cannot prove integrity is not a
+    valid ``--resume auto`` candidate (explicit ``--resume <path>`` still
+    restores it)."""
+    if not os.path.isdir(path):
+        return False
+    recorded = read_checkpoint_meta(path).get("checksum")
+    if not recorded:
+        return False
+    return checkpoint_checksum(path) == recorded
 
 
 def load_checkpoint(path: str, example_state: DDPGState,

@@ -1,0 +1,131 @@
+"""Chaos smoke: a tiny fault-injected train run must self-heal to rc=0.
+
+The CI-stage proof that the resilience subsystem's recovery paths actually
+execute: a 4-episode CPU training run with an injected prefetcher death
+AND a NaN-poisoned episode (``GSC_FAULT_PLAN``-style plan passed via
+``--fault-plan``) must
+
+- exit 0 with a finite final learner state (state_finite == 1 on the last
+  drained episode event),
+- leave matching structured ``recovery`` events in the run's
+  ``events.jsonl`` (site=prefetcher/action=restart and
+  site=learner_state/action=rollback),
+- end the stream with ``run_end status=ok``.
+
+Run by ``tools/ci_check.sh`` after the lint/report stages; standalone:
+
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# NaN early so a post-rollback episode still drains (and proves finite)
+# before the run ends; the prefetcher death hits the last staged episode
+PLAN = "nan_grads@1;prefetch_die@3"
+EXPECTED = {("prefetcher", "restart"), ("learner_state", "rollback")}
+
+
+def _configure_jax():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:   # the repo-shared persistent compile cache keeps this stage fast
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+
+def write_tiny_configs(cfg: str):
+    """Smallest trainable scenario (mirrors the test suite's tiny-config
+    shape): 3-node triangle, 3-step episodes, 8-wide nets."""
+    import yaml
+
+    from gsc_tpu.topology.synthetic import triangle, write_graphml
+
+    os.makedirs(cfg, exist_ok=True)
+    write_graphml(triangle(), os.path.join(cfg, "tri.graphml"))
+    dump = lambda name, obj: yaml.safe_dump(
+        obj, open(os.path.join(cfg, name), "w"))
+    dump("svc.yaml", {
+        "sfc_list": {"sfc_1": ["a", "b", "c"]},
+        "sf_list": {n: {"processing_delay_mean": 5.0,
+                        "processing_delay_stdev": 0.0} for n in "abc"}})
+    dump("sim.yaml", {
+        "inter_arrival_mean": 10.0, "deterministic_arrival": True,
+        "flow_dr_mean": 1.0, "flow_dr_stdev": 0.0,
+        "flow_size_shape": 0.001, "deterministic_size": True,
+        "run_duration": 100, "ttl_choices": [100], "max_flows": 32})
+    dump("agent.yaml", {
+        "graph_mode": True, "episode_steps": 3, "objective": "prio-flow",
+        "GNN_features": 4, "GNN_num_layers": 1, "GNN_num_iter": 1,
+        "actor_hidden_layer_nodes": [8], "critic_hidden_layer_nodes": [8],
+        "mem_limit": 32, "batch_size": 4, "nb_steps_warmup_critic": 3})
+    dump("sched.yaml", {
+        "training_network_files": [os.path.join(cfg, "tri.graphml")],
+        "inference_network": os.path.join(cfg, "tri.graphml")})
+    return [os.path.join(cfg, "agent.yaml"), os.path.join(cfg, "sim.yaml"),
+            os.path.join(cfg, "svc.yaml"), os.path.join(cfg, "sched.yaml"),
+            "--max-nodes", "8", "--max-edges", "8", "--quiet"]
+
+
+def main() -> int:
+    _configure_jax()
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+
+    tmp = tempfile.mkdtemp(prefix="gsc_chaos_")
+    args = write_tiny_configs(os.path.join(tmp, "cfg"))
+    r = CliRunner().invoke(cli, [
+        "train", *args, "--episodes", "4",
+        "--result-dir", os.path.join(tmp, "res"),
+        "--fault-plan", PLAN])
+    if r.exit_code != 0:
+        print(r.output)
+        if r.exception is not None:
+            import traceback
+            traceback.print_exception(type(r.exception), r.exception,
+                                      r.exception.__traceback__)
+        print(f"chaos smoke: FAIL — train rc={r.exit_code} under plan "
+              f"{PLAN!r}")
+        return 1
+    rdir = json.loads(r.output.strip().splitlines()[-1])["result_dir"]
+    events = [json.loads(line)
+              for line in open(os.path.join(rdir, "events.jsonl"))]
+    seen = {(e.get("site"), e.get("action"))
+            for e in events if e["event"] == "recovery"}
+    missing = EXPECTED - seen
+    if missing:
+        print(f"chaos smoke: FAIL — recovery events missing {missing}; "
+              f"saw {seen}")
+        return 1
+    end = events[-1]
+    if end.get("event") != "run_end" or end.get("status") != "ok":
+        print(f"chaos smoke: FAIL — stream tail {end}")
+        return 1
+    episodes = [e for e in events if e["event"] == "episode"]
+    # the LAST drained episode ran on the rolled-back (finite) state
+    if not episodes or float(episodes[-1].get("state_finite", 0)) != 1.0:
+        print("chaos smoke: FAIL — final drained episode not finite: "
+              f"{episodes[-1] if episodes else None}")
+        return 1
+    print(f"chaos smoke: OK — survived {PLAN!r} "
+          f"({sorted(seen)} recoveries, run_end status=ok)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

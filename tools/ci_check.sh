@@ -21,6 +21,12 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+echo "== chaos smoke (resilience: injected faults must self-heal) =="
+# a tiny CPU train run under an injected prefetcher death + NaN episode
+# must exit 0 with matching structured `recovery` events in events.jsonl
+# (tools/chaos_smoke.py asserts the events and the finite final state)
+env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 echo "== tier-1 tests (ROADMAP.md verify command) =="
 # per-invocation log: concurrent ci_check runs must not interleave tees
 # and corrupt each other's DOTS_PASSED tally

@@ -22,6 +22,11 @@ does by default), prints:
   variant; more means weak-type scalars or shape drift re-triggering
   tracing);
 - every ``stall`` / ``invariant_violation`` record, verbatim fields;
+- a recovery timeline from the resilience subsystem's ``recovery`` /
+  ``escalation`` events: one line per self-healing action (dispatch retry,
+  prefetcher restart, pipeline-off degradation, learner-state rollback,
+  checkpoint resave, preemption snapshot) with per-(site, action) totals —
+  a run that exits 0 after surviving faults shows HOW it survived;
 - a device-memory growth check: bytes_in_use at the first vs last episode
   per device, flagged when growth exceeds ``--mem-growth-threshold``
   (a leaking HBM buffer shows as monotonic growth long before an OOM).
@@ -135,6 +140,8 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     stalls = [e for e in events if e.get("event") == "stall"]
     violations = [e for e in events
                   if e.get("event") == "invariant_violation"]
+    recoveries = [e for e in events if e.get("event") == "recovery"]
+    escalations = [e for e in events if e.get("event") == "escalation"]
     deltas = phase_deltas(episodes)
 
     rows = []
@@ -204,10 +211,22 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "phase_summary": phase_summary,
         "stalls": stalls,
         "invariant_violations": violations,
+        "recoveries": recoveries,
+        "escalations": escalations,
+        "recovery_totals": _recovery_totals(recoveries),
         "memory_growth_flags": mem_flags,
         "drop_totals": _drop_totals(episodes),
         "compiles": compile_summary(events, retrace_threshold),
     }
+
+
+def _recovery_totals(recoveries: List[Dict]) -> Dict[str, int]:
+    """``{"site/action": count}`` over the recovery timeline."""
+    totals: Dict[str, int] = {}
+    for ev in recoveries:
+        key = f"{ev.get('site', '?')}/{ev.get('action', '?')}"
+        totals[key] = totals.get(key, 0) + 1
+    return totals
 
 
 def _drop_totals(episodes: List[Dict]) -> Dict[str, int]:
@@ -271,6 +290,24 @@ def render_text(summary: Dict, out=sys.stdout):
         w(f"\n!! RETRACE CHURN: {', '.join(compiles['retrace_flags'])} "
           "traced more than the steady-state budget — look for weak-type "
           "scalars or shape drift in the episode loop\n")
+    if summary.get("recoveries"):
+        recs = summary["recoveries"]
+        w(f"\nrecovery timeline ({len(recs)} action(s); totals "
+          + json.dumps(summary.get("recovery_totals", {})) + "):\n")
+        for r in recs:
+            line = (f"  ep {r.get('episode', '-'):>4}  "
+                    f"{r.get('site', '?')}/{r.get('action', '?')}")
+            if r.get("fault"):
+                line += f"  fault={r['fault']}"
+            if r.get("attempt") is not None:
+                line += f"  attempt={r['attempt']}"
+            w(line + "\n")
+            if r.get("detail"):
+                w(f"        {r['detail']}\n")
+    for esc in summary.get("escalations") or []:
+        w(f"\n!! WATCHDOG ESCALATION: quiet {esc.get('age_s')}s "
+          f"(budget {esc.get('budget_s')}s x "
+          f"{esc.get('quiet_periods')} periods) -> {esc.get('action')}\n")
     if summary["stalls"]:
         w(f"\n!! {len(summary['stalls'])} STALL(s):\n")
         for s in summary["stalls"]:
@@ -293,9 +330,10 @@ def render_text(summary: Dict, out=sys.stdout):
               f"bytes (+{m['growth_pct']}%)\n")
     if not (summary["stalls"] or summary["invariant_violations"]
             or summary["memory_growth_flags"]
+            or summary.get("recoveries")
             or (summary.get("compiles") or {}).get("retrace_flags")):
         w("\nhealthy: no stalls, no invariant violations, no device "
-          "memory growth, no retrace churn\n")
+          "memory growth, no retrace churn, no recovery actions\n")
 
 
 # ------------------------------------------------------------------ selftest
@@ -358,6 +396,19 @@ def _synthetic_events(path: str, episodes: int = 5):
         emit({"event": "invariant_violation", "ts": base + episodes,
               "run": "selftest", "episode": 3,
               "violations": ["negative node_load"]})
+        # resilience recovery timeline: a dispatch retry and a rollback,
+        # plus one watchdog escalation — the report must surface all three
+        emit({"event": "recovery", "ts": base + 2, "run": "selftest",
+              "episode": 1, "site": "dispatch", "action": "retry",
+              "fault": "TransientDispatchError('injected')", "attempt": 1,
+              "detail": "backing off 0.05s before re-dispatch"})
+        emit({"event": "recovery", "ts": base + 3, "run": "selftest",
+              "episode": 2, "site": "learner_state", "action": "rollback",
+              "fault": "non_finite_state",
+              "detail": "restored snapshot of episode 1"})
+        emit({"event": "escalation", "ts": base + 4, "run": "selftest",
+              "age_s": 0.8, "budget_s": 0.2, "quiet_periods": 2,
+              "action": "callback"})
         emit({"event": "run_end", "ts": base + episodes + 1,
               "run": "selftest", "status": "ok", "episodes": episodes})
 
@@ -382,6 +433,10 @@ def selftest() -> int:
             "traces": 1, "xla_compiles": 1, "compile_s": 3.3}, comp
         assert summary["compiles"]["retrace_flags"] == ["leaky_fn"], \
             "retrace churn not flagged"
+        assert len(summary["recoveries"]) == 2, "recovery timeline lost"
+        assert summary["recovery_totals"] == {
+            "dispatch/retry": 1, "learner_state/rollback": 1}, summary
+        assert len(summary["escalations"]) == 1, "escalation not surfaced"
         assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
         deltas = phase_deltas([e for e in last_run(load_events(path))
                                if e.get("event") == "episode"])
