@@ -32,9 +32,14 @@ so a pair of runs attributes the pipeline's share of the throughput.
 ``--precision bf16`` (or GSC_BENCH_PRECISION) measures the mixed-precision
 policy (bf16 network compute + replay, f32 master state); every row
 records its ``precision`` so run-to-run comparisons attribute the dtype
-share.  A failed probe/run emits a structured ``{"status": "failed",
-"reason": ...}`` row — never a fake 0.0 measurement — so artifacts
-distinguish "slow" from "never ran".
+share.  ``--substep-impl pallas`` (GSC_BENCH_SUBSTEP_IMPL) measures the
+substep megakernel engine and ``--unroll N`` (GSC_BENCH_SCAN_UNROLL) the
+substep-scan unroll factor — the two op-count levers of the >=20x
+campaign; every row records ``substep_impl`` and ``unroll`` next to
+``pipeline``/``precision`` so the lever_sweep winner can be promoted and
+attributed per rung.  A failed probe/run emits a structured
+``{"status": "failed", "reason": ...}`` row — never a fake 0.0
+measurement — so artifacts distinguish "slow" from "never ran".
 
 Baseline: the reference publishes no numbers (BASELINE.md); its training
 loop is a single SimPy env + torch DDPG on one CPU core
@@ -124,6 +129,32 @@ def _precision() -> str:
     if prec not in ("f32", "bf16"):
         raise SystemExit(f"GSC_BENCH_PRECISION={prec!r} (expected f32|bf16)")
     return prec
+
+
+def _substep_impl() -> str:
+    """Substep engine of the measured stack (SimConfig.substep_impl):
+    'xla' (default; the hand-fused one-hot pipeline) or 'pallas' (the
+    substep megakernel — CPU/interpret-only until its Mosaic port, see
+    ops/pallas_substep.py).  Set by ``--substep-impl`` /
+    GSC_BENCH_SUBSTEP_IMPL; recorded in every row next to pipeline/
+    precision so a pair of runs attributes the engine share."""
+    impl = os.environ.get("GSC_BENCH_SUBSTEP_IMPL", "xla").strip() or "xla"
+    if impl not in ("xla", "pallas"):
+        raise SystemExit(
+            f"GSC_BENCH_SUBSTEP_IMPL={impl!r} (expected xla|pallas)")
+    return impl
+
+
+def _unroll() -> int:
+    """Substep-scan unroll factor (SimConfig.scan_unroll, default 1 =
+    the plain scan).  Set by ``--unroll`` / GSC_BENCH_SCAN_UNROLL;
+    recorded in every row — this is the sweep knob tools/lever_sweep.py
+    measures, surfaced here so a swept winner can be promoted per rung
+    without a code edit."""
+    unroll = _env_int("GSC_BENCH_SCAN_UNROLL", 1)
+    if unroll < 1:
+        raise SystemExit(f"GSC_BENCH_SCAN_UNROLL={unroll} must be >= 1")
+    return unroll
 
 
 def ladder():
@@ -237,7 +268,8 @@ def orchestrate():
             "reason": "TPU backend unreachable (init probe timed out after "
                       f"{PROBE_RETRIES} attempts)",
             "unit": "env-steps/s", "retries": 0,
-            "pipeline": _pipeline_enabled(), "precision": _precision()}))
+            "pipeline": _pipeline_enabled(), "precision": _precision(),
+            "substep_impl": _substep_impl(), "unroll": _unroll()}))
         sys.exit(1)
     best = None
     denom = baseline_sps()
@@ -258,6 +290,11 @@ def orchestrate():
             "baseline_scope": "reference env-physics only (no torch agent)",
             "pipeline": b.get("pipeline", True),
             "precision": b.get("precision", "f32"),
+            # engine knobs from the WORKER's banked row (same derived-
+            # from-what-ran rule as `knobs`): the substep implementation
+            # and the scan-unroll factor actually built into the stack
+            "substep_impl": b.get("substep_impl", "xla"),
+            "unroll": b.get("unroll", 1),
             # transparent retry accounting: 0 for a first-try number
             "retries": b.get("retries", 0),
             # knobs come from the WORKER's banked row — derived from the
@@ -335,7 +372,8 @@ def orchestrate():
             "metric": "env_steps_per_sec_per_chip",
             "status": "failed", "reason": "all ladder rungs failed",
             "unit": "env-steps/s", "retries": total_retries,
-            "pipeline": _pipeline_enabled(), "precision": _precision()}))
+            "pipeline": _pipeline_enabled(), "precision": _precision(),
+            "substep_impl": _substep_impl(), "unroll": _unroll()}))
         sys.exit(1)
     print(artifact(best))
 
@@ -469,15 +507,17 @@ def worker(replicas: int, chunk: int, episodes: int,
         # stack (flagship and hardcoded rungs alike) honors it — models,
         # replay shards and the learn burst all read agent.precision
         agent = dataclasses.replace(agent, precision=precision)
-    unroll = _env_int("GSC_BENCH_SCAN_UNROLL", 0)
-    if unroll:
+    # engine knobs (substep impl + scan unroll) rebuild the env's sim_cfg
+    # for EVERY scenario, so they legitimately tag all rows — top-level
+    # fields next to pipeline/precision, not `knobs` entries
+    substep_impl = _substep_impl()
+    unroll = _unroll()
+    if unroll != 1 or substep_impl != "xla":
         from gsc_tpu.env.env import ServiceCoordEnv
-        # scan_unroll rebuilds the env for EVERY scenario, so the knob
-        # legitimately tags all rows
-        knobs["scan_unroll"] = unroll
         env = ServiceCoordEnv(
             env.service,
-            dataclasses.replace(env.sim_cfg, scan_unroll=unroll),
+            dataclasses.replace(env.sim_cfg, scan_unroll=unroll,
+                                substep_impl=substep_impl),
             agent, env.limits)
     B = replicas
     # traffic sampled ON DEVICE: at B=256 the old host-stacked schedule was
@@ -543,6 +583,7 @@ def worker(replicas: int, chunk: int, episodes: int,
             "unit": "env-steps/s",
             "replicas": B, "chunk": chunk, "scenario": scenario,
             "pipeline": pipeline, "precision": precision,
+            "substep_impl": substep_impl, "unroll": unroll,
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),
@@ -607,6 +648,29 @@ if __name__ == "__main__":
         if prec not in ("f32", "bf16"):
             raise SystemExit(f"--precision expects f32|bf16, got {prec!r}")
         os.environ["GSC_BENCH_PRECISION"] = prec
+        del argv[i:i + 2]
+    if "--substep-impl" in argv:
+        # same missing-value contract: a silently-defaulted xla row would
+        # mislabel a run meant to measure the megakernel
+        i = argv.index("--substep-impl")
+        impl = argv[i + 1] if i + 1 < len(argv) else None
+        if impl not in ("xla", "pallas"):
+            raise SystemExit(f"--substep-impl expects xla|pallas, "
+                             f"got {impl!r}")
+        os.environ["GSC_BENCH_SUBSTEP_IMPL"] = impl
+        del argv[i:i + 2]
+    if "--unroll" in argv:
+        i = argv.index("--unroll")
+        val = argv[i + 1] if i + 1 < len(argv) else None
+        try:
+            unroll = int(val)
+        except (TypeError, ValueError):
+            raise SystemExit(f"--unroll expects a positive integer, "
+                             f"got {val!r}")
+        if unroll < 1:
+            raise SystemExit(f"--unroll expects a positive integer, "
+                             f"got {val!r}")
+        os.environ["GSC_BENCH_SCAN_UNROLL"] = str(unroll)
         del argv[i:i + 2]
     if argv and argv[0] == "--worker":
         worker(int(argv[1]), int(argv[2]), int(argv[3]),

@@ -12,6 +12,11 @@ telemetry contracts (PR 2), the precision-policy dtype discipline (PR 3)
 - :mod:`~gsc_tpu.analysis.baseline` — the suppression baseline that
   encodes accepted pre-existing cases (each with a written reason), so
   CI fails only on NEW findings.
+- :mod:`~gsc_tpu.analysis.hlo` — compiled-HLO structure metrics:
+  ``count_fusions`` (the op-count perf proxy that gates substep changes
+  — the rejected bit-exact-but-281->294-fusions scatter-merge is the
+  case it encodes), shared by ``tools/profile_substep.py``,
+  ``tools/lever_sweep.py`` and the tier-1 fusion-budget test.
 - :mod:`~gsc_tpu.analysis.sentinels` — the runtime side:
   :class:`CompileMonitor` (per-entry-point trace/compile counting, wired
   into ``events.jsonl`` as ``compile`` events), ``assert_no_retrace``
@@ -27,6 +32,7 @@ from .astlint import DONATED_SIGS, lint_files, lint_paths
 from .baseline import (apply_baseline, inline_suppression, load_baseline,
                        save_baseline)
 from .findings import RULE_IDS, RULE_TITLES, Finding, LintResult
+from .hlo import count_fusions, count_ops, hlo_text
 from .sentinels import (DEFAULT_WATCH, CompileMonitor, HostSyncError,
                         RetraceError, assert_no_retrace, no_host_sync)
 
@@ -35,6 +41,7 @@ __all__ = [
     "apply_baseline", "inline_suppression", "load_baseline",
     "save_baseline",
     "RULE_IDS", "RULE_TITLES", "Finding", "LintResult",
+    "count_fusions", "count_ops", "hlo_text",
     "DEFAULT_WATCH", "CompileMonitor", "HostSyncError", "RetraceError",
     "assert_no_retrace", "no_host_sync",
 ]

@@ -1,0 +1,53 @@
+"""Compiled-HLO structure metrics — the op-count perf proxy.
+
+The substep is op-COUNT bound (BENCH_NOTES round-5 roofline: ~60 small
+fusions at ~30 µs apiece, ~100x above the HBM roof), so the number of
+fusion computations in the compiled executable is the cheapest faithful
+proxy for its per-call overhead — countable on any backend, no chip
+window needed.  It exists as a GATE because bit-exactness alone is not
+enough: the rejected round-5 scatter-merge was bit-exact yet REGRESSED
+281 -> 294 fusions and lost throughput; a fusion-count check would have
+rejected it before the chip ever saw it.
+
+Consumers: ``tools/profile_substep.py --mfu`` (per-rung roofline rows),
+``tools/lever_sweep.py`` (per-cell rows), and the tier-1 fusion-budget
+regression test (``tests/test_megakernel.py``), which pins the compiled
+flagship-interval ``engine.apply`` count on the CPU backend and asserts
+the pallas megakernel path stays strictly below the XLA path.
+
+Stdlib-only on purpose (the gsc-lint convention for analysis/): the
+argument is an already-compiled jax ``Compiled`` object (or its
+``as_text()`` dump) — this module never imports jax.
+"""
+from __future__ import annotations
+
+__all__ = ["count_fusions", "count_ops", "hlo_text"]
+
+
+def hlo_text(compiled_or_text) -> str:
+    """Post-optimization HLO text of a ``jax`` ``Compiled`` object (the
+    result of ``jit(f).lower(*args).compile()``); strings pass through."""
+    if isinstance(compiled_or_text, str):
+        return compiled_or_text
+    return compiled_or_text.as_text()
+
+
+def count_fusions(compiled_or_text) -> int:
+    """Number of fusion computations in the compiled executable.
+
+    Counts ``" fusion("`` instruction sites in the post-optimization HLO
+    — fusion *calls*, including those inside while-loop bodies (an
+    ``lax.scan`` body compiles once, so a per-substep op costs one count,
+    not one per iteration).  Comparisons are only meaningful at a fixed
+    jaxlib version and backend; the budget test re-measures both sides of
+    its assertion in the same process for exactly that reason.
+    """
+    return hlo_text(compiled_or_text).count(" fusion(")
+
+
+def count_ops(compiled_or_text, op: str) -> int:
+    """Occurrences of an HLO op (e.g. ``"while"``, ``"gather"``,
+    ``"scatter"``, ``"dot"``) in the compiled executable — the drill-down
+    companion to :func:`count_fusions` (a CPU scatter lowers to a serial
+    ``while``, a fact the megakernel work keeps re-learning)."""
+    return hlo_text(compiled_or_text).count(f" {op}(")

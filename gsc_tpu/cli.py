@@ -142,7 +142,7 @@ def init_configs(out: str):
 
 def _build(agent_config, simulator_config, service, scheduler, seed,
            max_nodes, max_edges, resource_functions_path=None,
-           precision=None):
+           precision=None, substep_impl=None, unroll=None):
     from .config.loader import load_agent, load_scheduler, load_service, load_sim
     from .config.schema import EnvLimits
     from .env.driver import EpisodeDriver
@@ -151,7 +151,15 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
     # --precision overrides the agent yaml's (or default f32) policy
     agent = load_agent(agent_config,
                        **({"precision": precision} if precision else {}))
-    sim_cfg = load_sim(simulator_config)
+    # --substep-impl / --unroll override the simulator yaml's engine knobs
+    # (`is not None`, not truthiness: an explicit --unroll 0 must reach
+    # SimConfig validation and ERROR, never silently keep the yaml value)
+    sim_overrides = {}
+    if substep_impl is not None:
+        sim_overrides["substep_impl"] = substep_impl
+    if unroll is not None:
+        sim_overrides["scan_unroll"] = unroll
+    sim_cfg = load_sim(simulator_config, **sim_overrides)
     svc = load_service(service,
                        resource_functions_path=resource_functions_path)
     sched = load_scheduler(scheduler)
@@ -214,6 +222,21 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "params/optimizer/TD targets — ~2x MXU throughput, "
                    "half the replay HBM).  Unset = the agent yaml's "
                    "'precision' key (default f32)")
+@click.option("--substep-impl", type=click.Choice(["xla", "pallas"]),
+              default=None,
+              help="simulator substep engine override: xla (default; the "
+                   "hand-fused one-hot pipeline) or pallas (the substep "
+                   "megakernel, ONE kernel invocation per substep — "
+                   "bit-exact vs xla, CPU/interpret-only until its "
+                   "Mosaic port).  Unset = the simulator yaml's "
+                   "'substep_impl' key (default xla)")
+@click.option("--unroll", type=int, default=None,
+              help="substep-scan unroll factor override "
+                   "(SimConfig.scan_unroll; trades compile time for less "
+                   "scan overhead on the op-count-bound substep — sweep "
+                   "with tools/lever_sweep.py, then promote the winner "
+                   "here).  Unset = the simulator yaml's 'scan_unroll' "
+                   "key (default 1)")
 @click.option("--obs/--no-obs", "obs_enabled", default=True,
               show_default=True,
               help="unified run telemetry: per-episode events.jsonl "
@@ -266,9 +289,10 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          pipeline, precision, obs_enabled, obs_dir, obs_interval,
-          watchdog_budget, watchdog_escalate, check_invariants, fault_plan,
-          rollback, ckpt_interval, ckpt_retain, verbose):
+          pipeline, precision, substep_impl, unroll, obs_enabled, obs_dir,
+          obs_interval, watchdog_budget, watchdog_escalate,
+          check_invariants, fault_plan, rollback, ckpt_interval,
+          ckpt_retain, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -288,6 +312,10 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
 
     if resume and runs != 1:
         raise click.BadParameter("--resume only supports --runs 1")
+    if unroll is not None and unroll < 1:
+        # same contract as bench.py's --unroll: fail fast with the flag's
+        # name, not a SimConfig traceback from deep inside the run loop
+        raise click.BadParameter("--unroll must be a positive integer")
     if resume == "auto":
         # newest checksummed checkpoint under the result root that still
         # validates — a corrupted newest (half-written at the kill, bit
@@ -353,7 +381,9 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         env, driver, agent = _build(agent_config, simulator_config, service,
                                     scheduler, run_seed, max_nodes, max_edges,
                                     resource_functions_path,
-                                    precision=precision)
+                                    precision=precision,
+                                    substep_impl=substep_impl,
+                                    unroll=unroll)
         obs = None
         if obs_enabled:
             from .obs import RunObserver
@@ -370,6 +400,11 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
                             "precision": agent.precision,
+                            # the EFFECTIVE engine knobs (yaml or flag),
+                            # read back from the built sim_cfg so the
+                            # recorded values can't drift from what ran
+                            "substep_impl": env.sim_cfg.substep_impl,
+                            "unroll": env.sim_cfg.scan_unroll,
                             "result_dir": rdir,
                             "ckpt_interval": ckpt_interval,
                             **({"fault_plan": plan.summary()} if plan

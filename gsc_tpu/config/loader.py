@@ -107,9 +107,11 @@ def load_sim(path: str, **overrides) -> SimConfig:
     if "prediction" in cfg:
         kw["prediction"] = bool(cfg["prediction"])
     for key in ("max_flows", "release_horizon",
-                "admission_iters", "wrr_rank_levels"):
+                "admission_iters", "wrr_rank_levels", "scan_unroll"):
         if key in cfg:
             kw[key] = int(cfg[key])
+    if "substep_impl" in cfg:
+        kw["substep_impl"] = str(cfg["substep_impl"])
     if "controller_class" in cfg:
         kw["controller"] = {"DurationController": "duration",
                             "FlowController": "per_flow"}.get(

@@ -275,6 +275,14 @@ class SimConfig:
     # (and a run_duration/dt divisibility requirement) for less scan
     # overhead on a substep made of many small fusions.
     scan_unroll: int = 1
+    # Substep implementation (mirrors AgentConfig.gnn_impl): "xla" = the
+    # hand-fused one-hot XLA pipeline (default, the reference-parity
+    # workhorse); "pallas" = the substep MEGAKERNEL — the whole
+    # admission/release chain as ONE pallas_call per substep
+    # (gsc_tpu/ops/pallas_substep.py; interpret-mode on CPU, bit-exact vs
+    # "xla" by construction and by the `pytest -m megakernel` suite).
+    # Per-flow control (controller="per_flow") stays on the XLA path.
+    substep_impl: str = "xla"
 
     def __post_init__(self):
         if self.use_states and len(self.states) != 2:
@@ -289,6 +297,19 @@ class SimConfig:
                 "'duration' or 'per_flow'; reference spellings "
                 "DurationController/FlowController are mapped by the "
                 "loader)")
+        if self.substep_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown substep_impl {self.substep_impl!r} "
+                "(expected 'xla' or 'pallas')")
+        if self.substep_impl == "pallas" and self.controller == "per_flow":
+            # the megakernel covers the batch-control (DurationController)
+            # substep only; per-flow external decisions would silently run
+            # the XLA body anyway — fail fast instead of faking the knob
+            raise ValueError(
+                "substep_impl='pallas' supports only controller='duration' "
+                "(per-flow control runs the XLA substep)")
+        if self.scan_unroll < 1:
+            raise ValueError("scan_unroll must be >= 1")
 
     @property
     def substeps_per_run(self) -> int:

@@ -237,6 +237,10 @@ class SimEngine:
         max_hold = (self.H - 1) * self.dt
         if cfg.run_duration > max_hold:
             raise ValueError("release_horizon must cover at least one run_duration")
+        # static deterministic-processing-delay flag, shared by both
+        # substep impls (the pallas path draws its noise OUTSIDE the
+        # kernel with the same key, so the rng stream is impl-invariant)
+        self._det_proc = float(np.max(self.tables.proc_std)) == 0.0
 
     # ------------------------------------------------------------------ init
     def init(self, rng, topo: Topology) -> SimState:
@@ -337,6 +341,42 @@ class SimEngine:
     def _substep(self, state: SimState, topo: Topology,
                  traffic: TrafficSchedule, cap_now: jnp.ndarray,
                  ext_decisions: jnp.ndarray | None = None) -> SimState:
+        """Dispatch on ``cfg.substep_impl``: "xla" = the hand-fused
+        one-hot pipeline below; "pallas" = the substep megakernel (ONE
+        pallas_call per substep, ops/pallas_substep.py — bit-exact vs
+        the XLA body, asserted by ``pytest -m megakernel``).  Per-flow
+        external decisions always run the XLA body (SimConfig rejects
+        the pallas impl for controller="per_flow")."""
+        if self.cfg.substep_impl == "pallas" and ext_decisions is None:
+            return self._substep_pallas(state, topo, traffic, cap_now)
+        return self._substep_xla(state, topo, traffic, cap_now,
+                                 ext_decisions)
+
+    def _substep_pallas(self, state: SimState, topo: Topology,
+                        traffic: TrafficSchedule,
+                        cap_now: jnp.ndarray) -> SimState:
+        """Megakernel path: advance the rng stream EXACTLY as the XLA
+        body does (split; stochastic configs draw the [M] processing-
+        delay normals from the same k_proc), then run the whole substep
+        as one kernel invocation."""
+        # lazy import: the kernel module reuses this module's one-hot
+        # helpers, so the dependency edge must point pallas_substep ->
+        # engine (resolved once at first trace, never per step)
+        from ..ops.pallas_substep import substep_megakernel
+
+        rng, k_proc = jax.random.split(state.rng)
+        if self._det_proc:
+            noise = jnp.zeros((self.M,), jnp.float32)
+        else:
+            noise = jax.random.normal(k_proc, (self.M,))
+        state = state.replace(rng=rng)
+        return substep_megakernel(state, topo, traffic, cap_now, noise,
+                                  tables=self.tables, cfg=self.cfg,
+                                  limits=self.limits, det=self._det_proc)
+
+    def _substep_xla(self, state: SimState, topo: Topology,
+                     traffic: TrafficSchedule, cap_now: jnp.ndarray,
+                     ext_decisions: jnp.ndarray | None = None) -> SimState:
         F = state.flows
         m = state.metrics
         dt = self.dt
@@ -612,7 +652,7 @@ class SimEngine:
              jnp.asarray(self.tables.startup_delay)], axis=-1), oh_sf)
         pmean = proc_tab[:, 0]
         pstd = proc_tab[:, 1]
-        if float(np.max(self.tables.proc_std)) == 0.0:
+        if self._det_proc:
             # fully deterministic processing delays (the flagship abc.yaml
             # case): |N(mean, 0)| == mean, so skip the per-substep threefry
             # draw entirely — measured ~10% of substep wall (r3 profile).

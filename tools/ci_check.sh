@@ -21,6 +21,15 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+echo "== megakernel interpret-parity smoke (pallas substep == xla) =="
+# one fast scenario through both substep impls, full post-interval state
+# bit-compared (the standalone `pytest -m megakernel` group runs the whole
+# battery inside tier-1 below; this stage fails FAST and by name when the
+# kernel drifts)
+env JAX_PLATFORMS=cpu python -m pytest \
+    "tests/test_megakernel.py::test_megakernel_parity_smoke" -q \
+    -p no:cacheprovider
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

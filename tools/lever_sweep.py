@@ -3,6 +3,12 @@ that the r4 profile work identified but never measured on chip:
 
 - ``scan_unroll``: the substep loop is a chain of small fusions, so scan
   loop machinery is a visible wall fraction (engine.py:283-286);
+- ``substep_impl``: the XLA one-hot engine vs the pallas substep
+  megakernel (SimConfig.substep_impl; CPU/interpret-only until the
+  Mosaic port, so chip grids stay xla while the smoke grid carries a
+  pallas cell).  Every cell also records ``hlo_fusions``
+  (gsc_tpu.analysis.hlo.count_fusions — the op-count proxy that gates
+  substep changes; ``--no-fusions`` skips the extra AOT compile);
 - ``max_flows``: every [M,*] one-hot contraction scales with the flow
   table; the flagship's M=128 has headroom over its ~64-flow peak
   occupancy (arrival budget right-sizing, VERDICT r4 item 2);
@@ -37,22 +43,29 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 GRIDS = {
-    # (replicas, chunk, max_flows, scan_unroll)
+    # (replicas, chunk, max_flows, scan_unroll, substep_impl).  The chip
+    # grids sweep the XLA engine's unroll knob (the never-swept r4 lever);
+    # the pallas megakernel joins them once its Mosaic lowering lands
+    # (ops/pallas_substep.py docstring) — today it is CPU/interpret-only,
+    # so only the smoke grid carries a pallas cell.
     "default": list(itertools.product((256, 512), (50,), (96, 128),
-                                      (1, 2, 4))),
+                                      (1, 2, 4), ("xla",))),
     "wide": list(itertools.product((256, 512), (25, 50, 100), (96, 128),
-                                   (1, 2, 4))),
-    "smoke": [(2, 5, 32, 1), (2, 5, 32, 2)],
+                                   (1, 2, 4), ("xla",))),
+    "smoke": [(2, 5, 32, 1, "xla"), (2, 5, 32, 2, "xla"),
+              (2, 5, 32, 1, "pallas")],
 }
 
 
-def measure(B, chunk, max_flows, unroll, calls, episode_steps):
+def measure(B, chunk, max_flows, unroll, impl, calls, episode_steps,
+            fusions=True):
     import dataclasses
 
     import jax
     import jax.numpy as jnp
 
     from __graft_entry__ import _flagship
+    from gsc_tpu.analysis.hlo import count_fusions
     from gsc_tpu.env.env import ServiceCoordEnv
     from gsc_tpu.parallel import ParallelDDPG
     from gsc_tpu.sim.traffic_device import DeviceTraffic
@@ -60,10 +73,11 @@ def measure(B, chunk, max_flows, unroll, calls, episode_steps):
     env0, agent, topo, _ = _flagship(episode_steps=episode_steps,
                                      max_flows=max_flows,
                                      gen_traffic=False)
-    if unroll != 1:
+    if unroll != 1 or impl != "xla":
         env0 = ServiceCoordEnv(
             env0.service, dataclasses.replace(env0.sim_cfg,
-                                              scan_unroll=unroll),
+                                              scan_unroll=unroll,
+                                              substep_impl=impl),
             agent, env0.limits)
     dt = DeviceTraffic(env0.sim_cfg, env0.service, topo, episode_steps)
     traffic = jax.jit(lambda k: dt.sample_batch(k, B))(jax.random.PRNGKey(0))
@@ -80,21 +94,30 @@ def measure(B, chunk, max_flows, unroll, calls, episode_steps):
         return out[:4]
 
     t_c = time.time()
-    carry = call((state, buffers, env_states, obs), 0)
+    carry = call((state, buffers, env_states, obs), jnp.int32(0))
     jax.block_until_ready(carry)
     compile_s = time.time() - t_c
-    carry = call(carry, chunk)          # warm (donation steady state)
+    carry = call(carry, jnp.int32(chunk))   # warm (donation steady state)
     jax.block_until_ready(carry)
     t0 = time.time()
     for c in range(calls):
-        carry = call(carry, (c + 2) * chunk)
+        carry = call(carry, jnp.int32((c + 2) * chunk))
     jax.block_until_ready(carry)
     wall = time.time() - t0
-    return {"replicas": B, "chunk": chunk, "max_flows": max_flows,
-            "scan_unroll": unroll,
-            "env_steps_per_sec": round(calls * chunk * B / wall, 1),
-            "per_call_s": round(wall / calls, 3),
-            "compile_s": round(compile_s, 1)}
+    row = {"replicas": B, "chunk": chunk, "max_flows": max_flows,
+           "scan_unroll": unroll, "substep_impl": impl,
+           "env_steps_per_sec": round(calls * chunk * B / wall, 1),
+           "per_call_s": round(wall / calls, 3),
+           "compile_s": round(compile_s, 1)}
+    if fusions:
+        # the op-count proxy next to every rate (analysis.hlo — the gate
+        # that caught the bit-exact 281->294 scatter-merge).  AOT-lowers
+        # a wrapper program; the persistent cache absorbs the inner
+        # executable, --no-fusions skips it on tightly budgeted windows.
+        row["hlo_fusions"] = count_fusions(
+            jax.jit(call).lower(carry,
+                                jnp.int32((calls + 2) * chunk)).compile())
+    return row
 
 
 def _cell_in_process(cell, args):
@@ -108,12 +131,14 @@ def _cell_in_process(cell, args):
         _enable_compile_cache()
     except Exception:
         pass
-    B, chunk, mf, unroll = cell
+    B, chunk, mf, unroll, impl = cell
     try:
-        row = measure(B, chunk, mf, unroll, args.calls, args.episode_steps)
+        row = measure(B, chunk, mf, unroll, impl, args.calls,
+                      args.episode_steps, fusions=not args.no_fusions)
     except Exception as e:  # one faulted cell must not kill the sweep
         row = {"replicas": B, "chunk": chunk, "max_flows": mf,
-               "scan_unroll": unroll, "error": repr(e)[:200]}
+               "scan_unroll": unroll, "substep_impl": impl,
+               "error": repr(e)[:200]}
     jax.clear_caches()  # cap live executables/HBM across cells
     return row
 
@@ -122,15 +147,17 @@ def _cell_subprocess(cell, args):
     """Run one grid cell as a bounded child: a wedged-backend hang is
     killed at --cell-timeout instead of eating the stage budget, and the
     parent process never touches the chip (so it cannot be wedged)."""
-    B, chunk, mf, unroll = cell
+    B, chunk, mf, unroll, impl = cell
     cmd = [sys.executable, os.path.abspath(__file__),
-           "--cell", f"{B},{chunk},{mf},{unroll}",
+           "--cell", f"{B},{chunk},{mf},{unroll},{impl}",
            "--calls", str(args.calls),
            "--episode-steps", str(args.episode_steps)]
     if args.cpu:
         cmd.append("--cpu")
+    if args.no_fusions:
+        cmd.append("--no-fusions")
     tag = {"replicas": B, "chunk": chunk, "max_flows": mf,
-           "scan_unroll": unroll}
+           "scan_unroll": unroll, "substep_impl": impl}
     try:
         r = subprocess.run(cmd, timeout=args.cell_timeout,
                            capture_output=True, text=True)
@@ -160,13 +187,18 @@ def main():
     ap.add_argument("--in-process", action="store_true",
                     help="run cells in this process (no per-cell bound) — "
                          "CI/CPU smoke mode")
+    ap.add_argument("--no-fusions", action="store_true",
+                    help="skip the per-cell hlo_fusions count (saves one "
+                         "AOT wrapper compile per cell on tight windows)")
     ap.add_argument("--cell", default=None,
-                    help="internal: measure one 'B,chunk,mf,unroll' cell "
-                         "and print its row")
+                    help="internal: measure one 'B,chunk,mf,unroll[,impl]' "
+                         "cell and print its row")
     args = ap.parse_args()
 
     if args.cell:
-        cell = tuple(int(x) for x in args.cell.split(","))
+        parts = args.cell.split(",")
+        impl = parts[4] if len(parts) > 4 else "xla"
+        cell = tuple(int(x) for x in parts[:4]) + (impl,)
         print(json.dumps(_cell_in_process(cell, args)), flush=True)
         return
 

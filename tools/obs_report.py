@@ -8,8 +8,10 @@ Reads the event stream the ``gsc_tpu.obs`` subsystem writes (``cli train``
 does by default), prints:
 
 - a per-run header with the dtype policy (the ``precision`` event /
-  run_start meta: policy name plus param/gnn/mlp/replay dtypes) so a
-  throughput comparison across runs is attributable to precision;
+  run_start meta: policy name plus param/gnn/mlp/replay dtypes) and the
+  engine knobs (run_start meta: ``substep_impl`` + ``unroll``) so a
+  throughput comparison across runs is attributable to precision and
+  substep engine;
 - a per-episode table: SPS, return, success ratio, learner losses, the
   per-episode *delta* of each pipeline phase's host wall (the stream
   carries cumulative ``PhaseTimer`` totals), and device bytes-in-use;
@@ -201,12 +203,20 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
                                "mlp_compute", "replay_dtype")}
     elif run_start is not None and run_start.get("precision"):
         precision = {"name": run_start["precision"]}
+    # engine-knob header fields (run_start meta, cli train): the substep
+    # implementation and scan-unroll factor the run was built with, so a
+    # throughput comparison across runs attributes the engine share
+    engine = None
+    if run_start is not None and run_start.get("substep_impl"):
+        engine = {"substep_impl": run_start["substep_impl"],
+                  "unroll": run_start.get("unroll", 1)}
     return {
         "episodes": len(episodes),
         "run": episodes[0].get("run") if episodes else None,
         "runs_in_stream": runs_in_stream,
         "status": (last_run_end or {}).get("status"),
         "precision": precision,
+        "engine": engine,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -260,6 +270,10 @@ def render_text(summary: Dict, out=sys.stdout):
                       f"{prec.get('mlp_compute')} / replay "
                       f"{prec.get('replay_dtype')})")
         w(f"precision: {prec.get('name')}{detail}\n")
+    eng = summary.get("engine")
+    if eng:
+        w(f"substep: {eng.get('substep_impl')}  "
+          f"unroll: {eng.get('unroll')}\n")
     if summary.get("runs_in_stream", 1) > 1:
         w(f"(stream holds {summary['runs_in_stream']} appended runs — "
           "showing the last)\n")
@@ -346,7 +360,8 @@ def _synthetic_events(path: str, episodes: int = 5):
             f.write(json.dumps(rec) + "\n")
 
         emit({"event": "run_start", "ts": base, "run": "selftest",
-              "episodes": episodes, "precision": "bf16"})
+              "episodes": episodes, "precision": "bf16",
+              "substep_impl": "pallas", "unroll": 2})
         # the dtype-gauge event the trainer emits via record_precision
         emit({"event": "precision", "ts": base, "run": "selftest",
               "name": "bf16", "param_dtype": "float32",
@@ -423,6 +438,9 @@ def selftest() -> int:
             "name": "bf16", "param_dtype": "float32",
             "gnn_compute": "bfloat16", "mlp_compute": "bfloat16",
             "replay_dtype": "bfloat16"}, "precision header not surfaced"
+        assert summary["engine"] == {
+            "substep_impl": "pallas", "unroll": 2}, \
+            "engine-knob header not surfaced"
         assert len(summary["stalls"]) == 1, "stall not surfaced"
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1

@@ -90,6 +90,8 @@ def mfu_sweep(args):
     latency) bound, which is what the r3 trace showed pre-one-hot."""
     import jax
 
+    from gsc_tpu.analysis.hlo import count_fusions
+
     chunk = args.chunk
     rows = []
     for B in args.replicas:
@@ -97,7 +99,7 @@ def mfu_sweep(args):
         lowered = jax.jit(call).lower(*carry, 0)
         compiled = lowered.compile()
         flops, byts = _cost(compiled)
-        n_fusions = compiled.as_text().count(" fusion(")
+        n_fusions = count_fusions(compiled)
         out = compiled(*carry, 0)           # warm (engine already compiled)
         jax.block_until_ready(out)
         t0 = time.time()
