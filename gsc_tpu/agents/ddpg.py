@@ -163,6 +163,21 @@ class DDPG:
                            self.agent.mem_limit)
 
     # ------------------------------------------------------------- actions
+    def greedy_action(self, actor_params, obs):
+        """The greedy inference policy as a pure, loweable function of
+        (actor_params, obs): actor forward pass, clip to [0, 1], agent-side
+        post-processing (threshold + renormalize) — exactly the per-step op
+        sequence of ``Trainer.evaluate`` (inference.py:17-40 semantics: no
+        noise, no warmup branch, no learning).
+
+        Deliberately NOT jit-decorated: ``Trainer.evaluate`` runs it eagerly
+        (identical op-by-op to the historical inline code), while the
+        serving stack (``gsc_tpu.serve``) vmaps it over request batches and
+        AOT-lowers/exports the result per batch bucket."""
+        a = self.actor.apply(actor_params, obs)
+        a = jnp.clip(a, 0.0, 1.0)
+        return self.env.process_action(a)
+
     def choose_action(self, actor_params, obs, mask, global_step, key):
         """Warmup random masked action, else actor + Gaussian noise in scaled
         space (simple_ddpg.py:182-201)."""

@@ -28,6 +28,43 @@ def cli():
     """gsc-tpu: TPU-native service coordination framework."""
 
 
+def _apply_jax_cache(flag_value):
+    """Wire the persistent jax compilation cache into this process:
+    ``--jax-cache-dir`` wins, else ``GSC_JAX_CACHE_DIR``; unset leaves the
+    jax default (off) alone.  Returns the effective directory (or None)
+    so run_start obs meta can record what actually applied.  The test
+    suite has set this via conftest.py since PR 2 — production entry
+    points get the same compile-skipping here."""
+    d = flag_value or os.environ.get("GSC_JAX_CACHE_DIR")
+    if not d:
+        return None
+    d = os.path.abspath(d)
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:   # backend declines (e.g. unsupported platform)
+        click.echo(f"[jax-cache] not applied ({e})", err=True)
+        return None
+    return d
+
+
+_JAX_CACHE_HELP = (
+    "persistent jax compilation cache directory (XLA executables are "
+    "reused across processes — repeat runs skip identical compiles).  "
+    "Unset: the GSC_JAX_CACHE_DIR env var; neither = cache off.  The "
+    "effective dir is recorded in run_start obs meta")
+
+
+def _uniform_schedule_action(limits, node_mask):
+    """Flat [A] uniform dummy schedule over real nodes (the coordsim
+    smoke-run placement, shared by `simulate` and `serve`'s request-pool
+    roller)."""
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    sched[:, :, :, node_mask] = 1.0 / max(int(node_mask.sum()), 1)
+    return sched.reshape(-1)
+
+
 @cli.command("init-configs")
 @click.option("--out", default="configs", show_default=True)
 def init_configs(out: str):
@@ -285,6 +322,7 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--ckpt-retain", default=3, show_default=True,
               help="periodic checkpoints kept on disk (the last-good "
                    "pointer target is never pruned)")
+@click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
@@ -292,7 +330,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           pipeline, precision, substep_impl, unroll, obs_enabled, obs_dir,
           obs_interval, watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
-          ckpt_retain, verbose):
+          ckpt_retain, jax_cache_dir, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -310,6 +348,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         setup_result_dir,
     )
 
+    jax_cache_dir = _apply_jax_cache(jax_cache_dir)
     if resume and runs != 1:
         raise click.BadParameter("--resume only supports --runs 1")
     if unroll is not None and unroll < 1:
@@ -407,6 +446,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                             "unroll": env.sim_cfg.scan_unroll,
                             "result_dir": rdir,
                             "ckpt_interval": ckpt_interval,
+                            "jax_cache_dir": jax_cache_dir,
                             **({"fault_plan": plan.summary()} if plan
                                else {})})
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
@@ -557,16 +597,22 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                    "agent yaml for pre-meta checkpoints) so the greedy "
                    "episodes evaluate under the compute dtype the "
                    "checkpoint was trained with")
+@click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 def infer(agent_config, simulator_config, service, scheduler, checkpoint,
           episodes, seed, max_nodes, max_edges, resource_functions_path,
-          precision):
+          precision, jax_cache_dir):
     """Restore a checkpoint and run greedy test episodes
-    (inference.py:17-40)."""
+    (inference.py:17-40).  The JSON output splits compile+warmup wall
+    (``compile_warmup_s``: everything up to the first completed control
+    step) from steady-state episode time (``steady_s``) — the cold-start
+    cost the serving path (``cli serve``) exists to amortize is visible
+    here, not hidden inside the total."""
     from .agents.trainer import Trainer
     from .utils.checkpoint import load_full_or_partial, read_checkpoint_meta
 
     import numpy as _np
 
+    _apply_jax_cache(jax_cache_dir)
     if precision is None:
         precision = read_checkpoint_meta(checkpoint).get("precision")
     env, driver, agent = _build(agent_config, simulator_config, service,
@@ -585,6 +631,232 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
         example_extra={"episode": _np.asarray(0, _np.int32)})[0]["state"]
     out = trainer.evaluate(state, episodes=episodes, test_mode=True)
     click.echo(json.dumps(out))
+
+
+@cli.command()
+@click.argument("agent_config")
+@click.argument("simulator_config")
+@click.argument("service")
+@click.argument("scheduler")
+@click.argument("checkpoint", required=False)
+@click.option("--requests", default=64, show_default=True,
+              help="synthetic coordination requests the built-in load "
+                   "driver fires through the server (the programmatic "
+                   "surface is PolicyServer.submit)")
+@click.option("--concurrency", default=4, show_default=True,
+              help="closed-loop client threads submitting concurrently — "
+                   "what actually fills the larger batch buckets")
+@click.option("--buckets", default="1,4,8", show_default=True,
+              help="comma-separated batch-size buckets; each gets its own "
+                   "AOT-compiled executable, a request batch runs in the "
+                   "smallest bucket that fits it")
+@click.option("--deadline-ms", default=5.0, show_default=True,
+              help="max wait before a partially-filled batch flushes (the "
+                   "latency a lone request pays for batching)")
+@click.option("--artifact-cache", default=None,
+              help="compiled-policy artifact cache dir (serialized "
+                   "jax.export modules keyed by checkpoint fingerprint + "
+                   "shapes + precision + jaxlib).  Default: "
+                   "<result-dir>/serve_cache — shared across runs, so a "
+                   "warm restart skips policy tracing entirely")
+@click.option("--pool-steps", default=8, show_default=True,
+              help="env steps rolled (uniform schedule) to build the "
+                   "synthetic request pool of distinct observations")
+@click.option("--stats-interval", default=50, show_default=True,
+              help="completed requests between serve_stats events")
+@click.option("--request-timeout", default=120.0, show_default=True,
+              help="seconds one driver client waits for its answer")
+@click.option("--seed", default=0, show_default=True)
+@click.option("--max-nodes", default=24, show_default=True)
+@click.option("--max-edges", default=37, show_default=True)
+@click.option("--resource-functions-path", default=None,
+              help="dir (or .py file) of user resource-function plugins")
+@click.option("--result-dir", default="results", show_default=True)
+@click.option("--obs/--no-obs", "obs_enabled", default=True,
+              show_default=True,
+              help="serving telemetry through the run observer: "
+                   "serve_start/serve_stats events + latency histograms "
+                   "in events.jsonl/metrics.json (tools/obs_report.py "
+                   "renders the serving section)")
+@click.option("--obs-dir", default=None,
+              help="directory for events.jsonl/metrics.json "
+                   "(default: the run's result dir)")
+@click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
+def serve(agent_config, simulator_config, service, scheduler, checkpoint,
+          requests, concurrency, buckets, deadline_ms, artifact_cache,
+          pool_steps, stats_interval, request_timeout, seed, max_nodes,
+          max_edges, resource_functions_path, result_dir, obs_enabled,
+          obs_dir, jax_cache_dir):
+    """Serve coordination decisions from an AOT-compiled greedy policy.
+
+    With CHECKPOINT: restores the actor, ahead-of-time compiles the
+    batched greedy policy for every bucket (artifact-cache backed — a
+    warm restart deserializes instead of re-tracing, so startup drops
+    from minutes to seconds), then answers micro-batched requests.
+    Without CHECKPOINT: the SPR shortest-path heuristic serves as the
+    non-learned fallback tier through the same queue and accounting.
+
+    This command drives itself with a synthetic closed-loop request load
+    (--requests/--concurrency over a pool of real observations) and
+    reports requests/s + p50/p99 latency as JSON — the in-process SLA
+    measurement loop that tools/serve_bench.py banks as SERVE_*.json."""
+    import threading
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from .agents.ddpg import DDPG
+    from .serve import (ArtifactCache, GreedyServePolicy, PolicyServer,
+                        SPRFallbackPolicy)
+    from .utils.experiment import setup_result_dir
+
+    try:
+        bucket_sizes = tuple(sorted({int(b) for b in buckets.split(",")}))
+        if not bucket_sizes or any(b < 1 for b in bucket_sizes):
+            raise ValueError
+    except ValueError:
+        raise click.BadParameter(
+            f"--buckets must be comma-separated positive ints, got "
+            f"{buckets!r}")
+    if requests < 1 or concurrency < 1:
+        raise click.BadParameter("--requests and --concurrency must be "
+                                 "positive")
+    jax_cache_dir = _apply_jax_cache(jax_cache_dir)
+
+    precision = None
+    if checkpoint:
+        from .utils.checkpoint import read_checkpoint_meta
+        precision = read_checkpoint_meta(checkpoint).get("precision")
+    env, driver, agent = _build(agent_config, simulator_config, service,
+                                scheduler, seed, max_nodes, max_edges,
+                                resource_functions_path,
+                                precision=precision)
+    ddpg = DDPG(env, agent)
+    topo, traffic = driver.episode(0, test_mode=True)
+    env_state, obs0 = env.reset(jax.random.PRNGKey(seed), topo, traffic)
+
+    # request pool: distinct real observations from rolling the env under
+    # the uniform dummy schedule (works with or without a checkpoint) —
+    # collected BEFORE serving starts so pool construction never pollutes
+    # the latency measurement
+    to_host = lambda tree: jax.tree_util.tree_map(_np.asarray, tree)
+    uniform_action = jnp.asarray(_uniform_schedule_action(
+        env.limits, _np.asarray(topo.node_mask)))
+    pool = [to_host(obs0)]
+    ob = obs0
+    for _ in range(max(pool_steps, 0)):
+        env_state, ob, _, _, _ = env.step(env_state, topo, traffic,
+                                          uniform_action)
+        pool.append(to_host(ob))
+
+    rdir = setup_result_dir(result_dir, "serve")
+    cache_dir = artifact_cache or os.path.join(result_dir, "serve_cache")
+    tier = "learned" if checkpoint else "spr"
+    obs_rec = None
+    if obs_enabled:
+        from .obs import RunObserver
+        obs_rec = RunObserver(obs_dir or rdir, tags={"seed": seed})
+        obs_rec.start(meta={
+            "mode": "serve", "tier": tier, "seed": seed,
+            "requests": requests, "concurrency": concurrency,
+            "buckets": list(bucket_sizes), "deadline_ms": deadline_ms,
+            "precision": agent.precision,
+            "substep_impl": env.sim_cfg.substep_impl,
+            "unroll": env.sim_cfg.scan_unroll,
+            "jax_cache_dir": jax_cache_dir,
+            "checkpoint": checkpoint, "result_dir": rdir})
+    # the latency/queue series live in the hub, and the command's JSON
+    # output is read off them — so --no-obs (no events.jsonl/metrics.json)
+    # still gets a private, sink-less hub; otherwise p50/p99 would print
+    # as a fake-perfect 0.0 instead of a measurement
+    if obs_rec is not None:
+        hub = obs_rec.hub
+    else:
+        from .obs import MetricsHub
+        hub = MetricsHub(tags={"seed": seed})
+
+    try:
+        if checkpoint:
+            from .utils.checkpoint import (checkpoint_fingerprint,
+                                           load_full_or_partial)
+            example = ddpg.init(jax.random.PRNGKey(0), obs0)
+            example_buffer = ddpg.init_buffer(obs0)
+            state = load_full_or_partial(
+                checkpoint, example, example_buffer=example_buffer,
+                example_extra={"episode": _np.asarray(0, _np.int32)}
+            )[0]["state"]
+            server = PolicyServer(
+                policy=GreedyServePolicy(ddpg, obs0),
+                params=state.actor_params,
+                buckets=bucket_sizes, deadline_ms=deadline_ms,
+                cache=ArtifactCache(cache_dir),
+                fingerprint=checkpoint_fingerprint(checkpoint),
+                precision=agent.precision,
+                substep_impl=env.sim_cfg.substep_impl,
+                graph_mode=agent.graph_mode, hub=hub,
+                stats_interval=stats_interval)
+        else:
+            server = PolicyServer(
+                fallback=SPRFallbackPolicy(topo, env.limits, obs0),
+                buckets=bucket_sizes, deadline_ms=deadline_ms, hub=hub,
+                stats_interval=stats_interval)
+        server.start()
+
+        # closed-loop load: each client thread submits its share
+        # sequentially, so at most --concurrency requests are in flight
+        errors = []
+        shares = [requests // concurrency + (1 if i < requests % concurrency
+                                             else 0)
+                  for i in range(concurrency)]
+
+        def client(tid: int, n: int):
+            for j in range(n):
+                ob_h = pool[(tid + j * concurrency) % len(pool)]
+                try:
+                    server.submit(ob_h).result(request_timeout)
+                except Exception as e:  # noqa: BLE001 - surfaced in JSON
+                    errors.append(f"client{tid}/{j}: {e}")
+
+        t0 = _time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i, n), daemon=True)
+                   for i, n in enumerate(shares) if n]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.perf_counter() - t0
+        lat = server.latency_summary() or {}
+        per_bucket = {}
+        for b in bucket_sizes:
+            s = server.latency_summary(b)
+            if s and s.get("count"):
+                per_bucket[str(b)] = {
+                    "requests": int(s["count"]),
+                    "p50_ms": round(s["p50"], 3),
+                    "p99_ms": round(s["p99"], 3)}
+        server.close()
+    except BaseException:
+        if obs_rec is not None:
+            try:
+                obs_rec.close(status="error")
+            except Exception:
+                pass
+        raise
+    if obs_rec is not None:
+        obs_rec.close(status="ok")
+    click.echo(json.dumps({
+        "tier": server.tier, "requests": requests,
+        "errors": len(errors), "error_detail": errors[:5],
+        "wall_s": round(wall, 3),
+        "rps": round(requests / wall, 3) if wall > 0 else 0.0,
+        "p50_ms": round(lat.get("p50", 0.0), 3),
+        "p99_ms": round(lat.get("p99", 0.0), 3),
+        "buckets": per_bucket,
+        "startup": server.startup,
+        "artifact_cache": cache_dir if checkpoint else None,
+        "jax_cache_dir": jax_cache_dir,
+        "result_dir": rdir}))
 
 
 @cli.command()
@@ -643,7 +915,6 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
     engine = SimEngine(svc, sim_cfg, limits)
 
     nm = np.asarray(topo.node_mask)
-    n_real = int(nm.sum())
     state = engine.init(jax.random.PRNGKey(seed), topo)
     if sim_cfg.controller == "per_flow":
         # FlowController granularity (flow_controller.py:21-92): each
@@ -672,8 +943,8 @@ def simulate(duration, network, service, config, seed, max_nodes, max_edges,
                 state, metrics = engine.apply_per_flow(state, topo, traffic,
                                                        decide_local)
     else:
-        sched = np.zeros(limits.scheduling_shape, np.float32)
-        sched[:, :, :, nm] = 1.0 / n_real
+        sched = _uniform_schedule_action(limits, nm).reshape(
+            limits.scheduling_shape)
         placement = jnp.asarray(np.broadcast_to(nm[:, None],
                                                 (max_nodes, limits.sf_pool)))
         for _ in range(steps):

@@ -1,0 +1,24 @@
+"""gsc_tpu.serve — AOT-compiled policy serving with request micro-batching.
+
+The production inference path (ROADMAP item 4): ``cli serve`` / the
+programmatic :class:`PolicyServer` answer coordination requests from an
+ahead-of-time compiled greedy policy (per batch-size bucket, persisted in
+an on-disk artifact cache keyed by checkpoint fingerprint), folding
+concurrent requests into padded device batches with a deadline flush, and
+streaming p50/p99 latency through the run's MetricsHub.  Without a
+checkpoint the SPR shortest-path heuristic serves as the non-learned
+fallback tier.
+"""
+from .batcher import MicroBatcher, ServeError, ServeFuture
+from .cache import ArtifactCache, cache_material
+from .fallback import SPRFallbackPolicy, spr_schedule_action
+from .policy import (GreedyServePolicy, ObsTemplate, exec_fn_name,
+                     policy_fn_name)
+from .server import PolicyServer
+
+__all__ = [
+    "ArtifactCache", "GreedyServePolicy", "MicroBatcher", "ObsTemplate",
+    "PolicyServer", "SPRFallbackPolicy", "ServeError", "ServeFuture",
+    "cache_material", "exec_fn_name", "policy_fn_name",
+    "spr_schedule_action",
+]

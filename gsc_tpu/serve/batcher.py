@@ -1,0 +1,224 @@
+"""Request micro-batcher: thread-safe queue + deadline-based flusher.
+
+Concurrent coordination requests land on a bounded queue; one batcher
+thread folds them into bucketed device batches:
+
+- a flush fires when the OLDEST queued request has waited ``deadline_ms``
+  or the largest bucket is full, whichever comes first — so a lone request
+  pays at most the deadline, and a burst amortizes one device call;
+- the flushed batch runs in the smallest configured bucket that fits it,
+  padded by repeating the last real request (see
+  ``ObsTemplate.stack_pad``); answers are sliced back per request.
+
+Each request's answer is bit-identical regardless of batch-mates: the
+bucketed policy is a ``vmap`` over the request axis, so rows never
+interact (test-asserted padding-invariance).  Latency accounting flows
+through the shared :class:`~gsc_tpu.obs.MetricsHub`:
+
+- ``serve_latency_ms`` histogram (overall and tagged per bucket),
+- ``serve_batch_ms`` device-call histogram per bucket,
+- ``serve_requests_total`` / ``serve_batches_total{bucket=..}`` counters,
+- ``serve_queue_depth`` gauge sampled at every flush.
+
+The batcher is transport-agnostic: ``submit`` is the in-process API
+(``PolicyServer`` wraps it); an RPC front-end would call the same method.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .policy import ObsTemplate
+
+
+class ServeError(RuntimeError):
+    """The device call answering this request failed (the error is
+    replicated into every affected request's future)."""
+
+
+class ServeFuture:
+    """Minimal future for one request: blocks on ``result`` until the
+    batcher fills it (or raises what the device call raised)."""
+
+    __slots__ = ("_event", "_result", "_error", "t_enqueued")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self.t_enqueued = time.perf_counter()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still queued after "
+                               f"{timeout}s")
+        if self._error is not None:
+            raise ServeError(str(self._error)) from self._error
+        return self._result
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """One consumer thread over a bounded request queue.
+
+    ``run_batch(leaves, n_real, bucket) -> np.ndarray [bucket, A]`` is the
+    execution backend (the server provides the AOT-compiled device call or
+    the fallback tier); ``leaves`` are the bucket-stacked obs arrays.
+    """
+
+    def __init__(self, run_batch: Callable, template: ObsTemplate,
+                 buckets: Sequence[int] = (1, 4, 8),
+                 deadline_ms: float = 5.0, hub=None,
+                 max_queue: int = 4096,
+                 on_flush: Optional[Callable[[int, int], None]] = None):
+        if not buckets or any(b < 1 for b in buckets):
+            raise ValueError(f"buckets must be positive ints: {buckets!r}")
+        self.run_batch = run_batch
+        self.template = template
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.deadline_s = float(deadline_ms) / 1e3
+        self.hub = hub
+        self.on_flush = on_flush
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        # serializes submit's check+enqueue against stop's flag+sentinel:
+        # an accepted request is therefore ALWAYS queued ahead of _STOP,
+        # so it is served by the drain — without this, a submit that
+        # passed the flag check could enqueue after the consumer exited
+        # and its future would hang until the client timeout
+        self._submit_lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="gsc-serve-batcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        """Drain-then-stop: requests queued before the stop are still
+        answered; a ``submit`` racing it either lands ahead of the stop
+        sentinel (and is served) or raises ServeError at the call site —
+        never a silent until-timeout hang (the submit lock makes those
+        the only two outcomes)."""
+        if self._thread is None:
+            return
+        with self._submit_lock:
+            self._stopping = True
+            self._q.put(_STOP)
+        self._thread.join(timeout)
+        self._thread = None
+
+    @property
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    # -------------------------------------------------------------- submit
+    def submit(self, obs) -> ServeFuture:
+        """Enqueue one request (any obs pytree matching the template).
+        Template validation happens HERE, in the caller's thread — a
+        malformed request raises at the call site and never reaches the
+        shared device path."""
+        leaves = self.template.flatten(obs)
+        fut = ServeFuture()
+        with self._submit_lock:
+            if self._stopping:
+                raise ServeError("batcher is stopping — request rejected")
+            try:
+                self._q.put_nowait((fut, leaves))
+            except queue.Full:
+                raise ServeError(
+                    f"serve queue full ({self._q.maxsize} requests) — "
+                    "backpressure: retry or add capacity")
+        return fut
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            batch: List[Tuple[ServeFuture, List[np.ndarray]]] = [item]
+            deadline = item[0].t_enqueued + self.deadline_s
+            stop_after = False
+            while len(batch) < self.buckets[-1]:
+                remaining = deadline - time.perf_counter()
+                try:
+                    if remaining <= 0:
+                        # deadline already spent (e.g. the previous device
+                        # call outlasted it): still DRAIN what is already
+                        # queued, non-blocking — otherwise overload
+                        # degenerates to bucket-1 flushes exactly when
+                        # batching matters most
+                        nxt = self._q.get_nowait()
+                    else:
+                        nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            self._flush(batch)
+            if stop_after:
+                break
+        # backstop: the submit lock means no future can land behind the
+        # stop sentinel, but fail anything that somehow did (e.g. a second
+        # _STOP from a double stop()) instead of hanging its client
+        while True:
+            try:
+                leftover = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if leftover is _STOP:
+                continue
+            fut, _ = leftover
+            fut._error = ServeError("batcher stopped before this request "
+                                    "was served")
+            fut._event.set()
+
+    def _flush(self, batch):
+        k = len(batch)
+        bucket = next(b for b in self.buckets if b >= k)
+        stacked = self.template.stack_pad([leaves for _, leaves in batch],
+                                          bucket)
+        t0 = time.perf_counter()
+        try:
+            out = self.run_batch(stacked, k, bucket)
+        except BaseException as e:  # noqa: BLE001 - replicate into futures
+            for fut, _ in batch:
+                fut._error = e
+                fut._event.set()
+            if self.hub is not None:
+                self.hub.counter("serve_errors_total")
+            return
+        now = time.perf_counter()
+        out = np.asarray(out)
+        for i, (fut, _) in enumerate(batch):
+            fut._result = out[i]
+            if self.hub is not None:
+                lat_ms = (now - fut.t_enqueued) * 1e3
+                self.hub.observe("serve_latency_ms", lat_ms)
+                self.hub.observe("serve_latency_ms", lat_ms,
+                                 bucket=bucket)
+            fut._event.set()
+        if self.hub is not None:
+            self.hub.counter("serve_requests_total", k)
+            self.hub.counter("serve_batches_total", bucket=bucket)
+            self.hub.observe("serve_batch_ms", (now - t0) * 1e3,
+                             bucket=bucket)
+            self.hub.gauge("serve_queue_depth", self._q.qsize())
+        if self.on_flush is not None:
+            self.on_flush(k, bucket)

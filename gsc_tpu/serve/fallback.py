@@ -1,0 +1,74 @@
+"""Non-learned serving tier: the SPR heuristic at schedule granularity.
+
+When no checkpoint is given, the server answers from the shortest-path
+heuristic instead of refusing — the serving analogue of ``cli simulate
+--per-flow-algo spr``.  :class:`~gsc_tpu.sim.spr.ShortestPathAlgo` decides
+per *flow* against live engine state; a serving request wants a *schedule*
+tensor, so this module projects the same decision rule onto the schedule:
+
+1. a source node with its own capacity keeps its traffic (SPR rule 1:
+   process HERE);
+2. otherwise all of its weight goes to the nearest capable node by
+   shortest-path delay (rule 2), excluding unreachable nodes (the finite
+   ``INF_DELAY`` sentinel, exactly as ``ShortestPathAlgo.decide``);
+3. with no capable reachable node the weight stays put and the simulator
+   records the authentic NODE_CAP drop (rule 3).
+
+The projection is a pure function of the topology (capacities +
+shortest-path delays), so the fallback tier computes ONE flat action at
+server start and answers every request with it — microseconds per
+request, no device involvement, same queue/latency accounting as the
+learned tier.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..config.schema import EnvLimits
+from ..topology.compiler import INF_DELAY, Topology
+
+
+def spr_schedule_action(topo: Topology, limits: EnvLimits) -> np.ndarray:
+    """Flat ``[A]`` scheduling action (rows already one-hot, so the env's
+    threshold+renormalize post-processing is a fixed point)."""
+    node_mask = np.asarray(topo.node_mask)
+    cap = np.asarray(topo.node_cap)
+    pd = np.asarray(topo.path_delay)
+    n, c, s, _ = limits.scheduling_shape
+    sched = np.zeros(limits.scheduling_shape, np.float32)
+    capable = node_mask & (cap > 0)
+    for src in range(n):
+        if not node_mask[src]:
+            continue
+        if capable[src]:
+            dst = src                                    # rule 1
+        else:
+            delays = np.where(capable, pd[src], INF_DELAY)
+            dst = int(np.argmin(delays))                 # rule 2
+            if delays[dst] >= INF_DELAY:
+                dst = src                                # rule 3
+        sched[src, :, :, dst] = 1.0
+    return sched.reshape(-1)
+
+
+class SPRFallbackPolicy:
+    """Batcher backend for the fallback tier: replicates the precomputed
+    SPR schedule per request (obs content is deliberately ignored — the
+    heuristic is topology-static, which is exactly its value as the
+    always-available bottom tier).
+
+    ``sample_obs`` declares the request payload shape so clients stay
+    tier-agnostic: the same obs pytree a learned-tier request carries is
+    validated (and then ignored) here."""
+
+    def __init__(self, topo: Topology, limits: EnvLimits, sample_obs):
+        from .policy import ObsTemplate
+
+        self.action = spr_schedule_action(topo, limits)
+        self.template = ObsTemplate(sample_obs)
+
+    def run_batch(self, leaves, n_real: int, bucket: int) -> np.ndarray:
+        # tile, not broadcast_to: each request's future gets its own
+        # WRITABLE row, matching the learned tier's contract (broadcast
+        # views are read-only and alias one shared buffer)
+        return np.tile(self.action[None, :], (bucket, 1))

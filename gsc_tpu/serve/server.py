@@ -1,0 +1,238 @@
+"""PolicyServer — AOT-compiled policy + artifact cache + micro-batcher.
+
+Lifecycle of one serving process:
+
+1. ``start()`` prepares every batch bucket BEFORE the first request:
+   artifact-cache lookup -> ``jax.export.deserialize`` on a hit (no policy
+   trace at all), else trace+lower via
+   :meth:`~gsc_tpu.serve.policy.GreedyServePolicy.export_bucket` and
+   persist the serialized module; either way the bucket is warmed with one
+   dummy device call so the backend compile is also done up front.  A
+   corrupt cache entry logs, recompiles and overwrites — it never fails a
+   start.
+2. ``submit(obs)`` enqueues a request on the micro-batcher and returns a
+   :class:`~gsc_tpu.serve.batcher.ServeFuture`; ``submit_sync`` blocks.
+3. ``close()`` drains the queue and emits the final ``serve_stats`` event.
+
+Observability rides the run's :class:`~gsc_tpu.obs.MetricsHub`: the
+batcher feeds the latency/queue series (see its module doc), the server
+emits one ``serve_start`` event (tier, buckets, per-bucket cache hit +
+prepare wall, total startup) and periodic + final ``serve_stats`` events
+(requests, requests/s, p50/p99 overall and per bucket, occupancy) —
+``tools/obs_report.py`` renders them as the serving section.
+
+Without a checkpoint the server runs the SPR fallback tier
+(:class:`~gsc_tpu.serve.fallback.SPRFallbackPolicy`) through the same
+batcher and accounting, so the serving surface is always available.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .batcher import MicroBatcher, ServeFuture
+from .cache import ArtifactCache, cache_material
+from .fallback import SPRFallbackPolicy
+from .policy import GreedyServePolicy, exec_fn_name
+
+log = logging.getLogger("gsc_tpu.serve.server")
+
+
+def _make_exec(exported, name: str):
+    """Jit-wrap a deserialized exported module under a stable per-bucket
+    name (compile telemetry + retrace assertions key on it).  The wrapper
+    trace is trivial — the policy itself was traced at export time (or
+    never, on a cache hit)."""
+    import jax
+
+    def _exec(params, *leaves):
+        return exported.call(params, *leaves)
+
+    _exec.__name__ = name
+    return jax.jit(_exec)
+
+
+class PolicyServer:
+    """One serving process: compiled buckets (learned tier) or the SPR
+    heuristic (fallback tier) behind a deadline micro-batcher."""
+
+    def __init__(self, *, policy: Optional[GreedyServePolicy] = None,
+                 params=None, fallback: Optional[SPRFallbackPolicy] = None,
+                 buckets: Sequence[int] = (1, 4, 8),
+                 deadline_ms: float = 5.0,
+                 cache: Optional[ArtifactCache] = None,
+                 fingerprint: str = "none",
+                 precision: str = "f32", substep_impl: str = "xla",
+                 graph_mode: bool = True,
+                 hub=None, stats_interval: int = 50,
+                 max_queue: int = 4096):
+        if (policy is None) == (fallback is None):
+            raise ValueError("exactly one of policy (learned tier, with "
+                             "params) or fallback (SPR tier) is required")
+        if policy is not None and params is None:
+            raise ValueError("the learned tier needs actor params")
+        self.policy = policy
+        self.params = params
+        self.fallback = fallback
+        self.tier = "learned" if policy is not None else "spr"
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.deadline_ms = float(deadline_ms)
+        self.cache = cache
+        self.fingerprint = fingerprint
+        self.precision = precision
+        self.substep_impl = substep_impl
+        self.graph_mode = graph_mode
+        self.hub = hub
+        self.stats_interval = max(int(stats_interval), 1)
+        self.max_queue = max_queue
+        self.batcher: Optional[MicroBatcher] = None
+        self.startup: Dict = {}
+        self._exec: Dict[int, object] = {}
+        self._occupancy: Dict[int, int] = {}
+        self._completed = 0
+        self._last_stats_at = 0
+        self._t_started = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "PolicyServer":
+        t0 = time.perf_counter()
+        per_bucket: Dict[str, Dict] = {}
+        if self.tier == "learned":
+            for b in self.buckets:
+                per_bucket[str(b)] = self._prepare_bucket(b)
+            run_batch = self._run_learned
+            template = self.policy.template
+        else:
+            template = self.fallback.template
+            run_batch = self.fallback.run_batch
+        self.batcher = MicroBatcher(
+            run_batch, template, buckets=self.buckets,
+            deadline_ms=self.deadline_ms, hub=self.hub,
+            max_queue=self.max_queue, on_flush=self._on_flush).start()
+        self._t_started = time.perf_counter()
+        self.startup = {
+            "tier": self.tier,
+            "startup_s": round(self._t_started - t0, 3),
+            "buckets": per_bucket,
+            "cache_dir": self.cache.root if self.cache else None,
+        }
+        if self.hub is not None:
+            self.hub.event("serve_start", tier=self.tier,
+                           buckets=list(self.buckets),
+                           deadline_ms=self.deadline_ms,
+                           startup_s=self.startup["startup_s"],
+                           bucket_prepare=per_bucket,
+                           cache_dir=self.startup["cache_dir"],
+                           fingerprint=self.fingerprint)
+        return self
+
+    def _prepare_bucket(self, b: int) -> Dict:
+        """Load-or-compile + warm one bucket; returns its prepare stats."""
+        from jax import export as jax_export
+
+        t0 = time.perf_counter()
+        material = cache_material(
+            fingerprint=self.fingerprint, template=self.policy.template,
+            batch=b, precision=self.precision,
+            substep_impl=self.substep_impl, graph_mode=self.graph_mode,
+            # the actor is lowered through the configured GAT impl — a
+            # module artifact compiled under one impl must miss under the
+            # other (their numerics are only interpret-mode-equal)
+            gnn_impl=self.policy.ddpg.actor.gnn_impl)
+        exported, hit = None, False
+        blob = self.cache.load(material) if self.cache else None
+        if blob is not None:
+            try:
+                exported = jax_export.deserialize(bytearray(blob))
+                hit = True
+            except Exception as e:  # noqa: BLE001 - corrupt entry: recompile
+                log.warning(
+                    "serve artifact for bucket %d failed to deserialize "
+                    "(%s: %s) — recompiling and overwriting the entry",
+                    b, type(e).__name__, e)
+        if exported is None:
+            exported = self.policy.export_bucket(self.params, b)
+            if self.cache is not None:
+                self.cache.store(material, bytes(exported.serialize()))
+        self._exec[b] = _make_exec(exported, exec_fn_name(b))
+        self._warm_bucket(b)
+        return {"cache_hit": hit,
+                "prepare_s": round(time.perf_counter() - t0, 3)}
+
+    def _warm_bucket(self, b: int):
+        """One dummy call so the backend compile (and the wrapper trace)
+        happen at startup, never inside a request's latency."""
+        import jax
+
+        t = self.policy.template
+        zeros = [np.zeros((b,) + s, d)
+                 for s, d in zip(t.leaf_shapes, t.leaf_dtypes)]
+        jax.block_until_ready(self._exec[b](self.params, *zeros))
+
+    def close(self):
+        if self.batcher is not None:
+            self.batcher.stop()
+            self.batcher = None
+        self._emit_stats(final=True)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------ requests
+    def submit(self, obs) -> ServeFuture:
+        if self.batcher is None:
+            raise RuntimeError("PolicyServer not started")
+        return self.batcher.submit(obs)
+
+    def submit_sync(self, obs, timeout: Optional[float] = 60.0):
+        return self.submit(obs).result(timeout)
+
+    # ------------------------------------------------------------ internals
+    def _run_learned(self, leaves, n_real: int, bucket: int) -> np.ndarray:
+        return np.asarray(self._exec[bucket](self.params, *leaves))
+
+    def _on_flush(self, n_real: int, bucket: int):
+        self._occupancy[bucket] = self._occupancy.get(bucket, 0) + n_real
+        self._completed += n_real
+        if self._completed - self._last_stats_at >= self.stats_interval:
+            self._last_stats_at = self._completed
+            self._emit_stats()
+
+    def latency_summary(self, bucket: Optional[int] = None):
+        if self.hub is None:
+            return None
+        tags = {"bucket": bucket} if bucket is not None else {}
+        return self.hub.histogram_summary("serve_latency_ms", **tags)
+
+    def _emit_stats(self, final: bool = False):
+        if self.hub is None:
+            return
+        elapsed = (time.perf_counter() - self._t_started) \
+            if self._t_started else 0.0
+        lat = self.latency_summary() or {}
+        per_bucket = {}
+        for b in self.buckets:
+            s = self.latency_summary(b)
+            if s:
+                per_bucket[str(b)] = {"p50_ms": round(s["p50"], 3),
+                                      "p99_ms": round(s["p99"], 3),
+                                      "requests": int(s["count"])}
+        self.hub.event(
+            "serve_stats", tier=self.tier, final=final,
+            requests=self._completed,
+            rps=round(self._completed / elapsed, 3) if elapsed else 0.0,
+            p50_ms=round(lat.get("p50", 0.0), 3),
+            p99_ms=round(lat.get("p99", 0.0), 3),
+            mean_ms=round(lat.get("mean", 0.0), 3),
+            max_ms=round(lat.get("max", 0.0), 3),
+            queue_depth=int(self.hub.get_gauge("serve_queue_depth") or 0),
+            occupancy={str(b): n for b, n in
+                       sorted(self._occupancy.items())},
+            buckets=per_bucket)

@@ -137,6 +137,20 @@ def read_checkpoint_meta(path: str) -> dict:
     return meta
 
 
+def checkpoint_fingerprint(path: str) -> str:
+    """Stable content identity of a checkpoint, for keying derived
+    artifacts (the serving stack's compiled-policy cache).  Prefers the
+    sidecar's recorded content checksum (free to read; present on every
+    ``checksum=True`` save) and falls back to recomputing the sha256 tree
+    digest for checkpoints saved without one — either way, retraining or
+    touching any array file changes the fingerprint, so a stale compiled
+    policy can never be served against new weights."""
+    recorded = read_checkpoint_meta(path).get("checksum")
+    if recorded:
+        return recorded
+    return checkpoint_checksum(path)
+
+
 def verify_checkpoint(path: str) -> bool:
     """True iff ``path`` exists and its recomputed content checksum equals
     the sidecar's recorded one.  False for checkpoints saved without

@@ -30,6 +30,12 @@ env JAX_PLATFORMS=cpu python -m pytest \
     "tests/test_megakernel.py::test_megakernel_parity_smoke" -q \
     -p no:cacheprovider
 
+echo "== serve smoke (AOT policy serving: cold compile -> cache-hit restart) =="
+# tiny checkpoint -> in-process server -> N requests twice: run 1 must
+# write the compiled-policy artifacts and record p99; run 2 must hit the
+# cache on every bucket (tools/serve_smoke.py asserts rc, events, hits)
+env JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

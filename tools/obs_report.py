@@ -31,7 +31,11 @@ does by default), prints:
   a run that exits 0 after surviving faults shows HOW it survived;
 - a device-memory growth check: bytes_in_use at the first vs last episode
   per device, flagged when growth exceeds ``--mem-growth-threshold``
-  (a leaking HBM buffer shows as monotonic growth long before an OOM).
+  (a leaking HBM buffer shows as monotonic growth long before an OOM);
+- a serving section for ``cli serve`` runs, from the ``serve_start`` /
+  ``serve_stats`` events (gsc_tpu.serve.PolicyServer): tier, requests/s,
+  p50/p99 latency overall and per batch bucket, bucket occupancy, and
+  per-bucket startup (artifact-cache hit + prepare wall).
 
 ``--json`` emits the same summary as one machine-readable JSON object.
 ``--selftest`` synthesizes a stream (including a stall and a leak),
@@ -210,9 +214,32 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     if run_start is not None and run_start.get("substep_impl"):
         engine = {"substep_impl": run_start["substep_impl"],
                   "unroll": run_start.get("unroll", 1)}
+    # serving section (cli serve runs): the final serve_stats event holds
+    # the cumulative numbers; serve_start carries startup + cache hits
+    serve_start = next((e for e in events
+                        if e.get("event") == "serve_start"), None)
+    serve_stats = [e for e in events if e.get("event") == "serve_stats"]
+    serving = None
+    if serve_start is not None or serve_stats:
+        last = serve_stats[-1] if serve_stats else {}
+        serving = {
+            "tier": last.get("tier") or (serve_start or {}).get("tier"),
+            "requests": last.get("requests"),
+            "rps": last.get("rps"),
+            "p50_ms": last.get("p50_ms"),
+            "p99_ms": last.get("p99_ms"),
+            "queue_depth": last.get("queue_depth"),
+            "occupancy": last.get("occupancy") or {},
+            "buckets": last.get("buckets") or {},
+            "startup_s": (serve_start or {}).get("startup_s"),
+            "bucket_prepare": (serve_start or {}).get("bucket_prepare")
+            or {},
+        }
     return {
         "episodes": len(episodes),
-        "run": episodes[0].get("run") if episodes else None,
+        "run": (episodes[0].get("run") if episodes
+                else (serve_start or {}).get("run")),
+        "serving": serving,
         "runs_in_stream": runs_in_stream,
         "status": (last_run_end or {}).get("status"),
         "precision": precision,
@@ -277,6 +304,23 @@ def render_text(summary: Dict, out=sys.stdout):
     if summary.get("runs_in_stream", 1) > 1:
         w(f"(stream holds {summary['runs_in_stream']} appended runs — "
           "showing the last)\n")
+    sv = summary.get("serving")
+    if sv:
+        w(f"\nserving ({sv.get('tier')} tier): "
+          f"{sv.get('requests')} requests  {sv.get('rps')} req/s  "
+          f"p50 {sv.get('p50_ms')} ms  p99 {sv.get('p99_ms')} ms  "
+          f"startup {sv.get('startup_s')}s\n")
+        buckets = set(sv.get("buckets", {})) | set(sv.get("occupancy", {})) \
+            | set(sv.get("bucket_prepare", {}))
+        for b in sorted(buckets, key=int):
+            lat = sv.get("buckets", {}).get(b, {})
+            prep = sv.get("bucket_prepare", {}).get(b, {})
+            w(f"  bucket {b:>4}: occupancy "
+              f"{sv.get('occupancy', {}).get(b, 0):>6}   "
+              f"p50 {lat.get('p50_ms', '-'):>8} ms   "
+              f"p99 {lat.get('p99_ms', '-'):>8} ms   "
+              f"cache_hit {str(prep.get('cache_hit', '-')):<5} "
+              f"prepare {prep.get('prepare_s', '-')}s\n")
     rows = summary["rows"]
     if rows:
         w("(*_ms columns are phase-wall deltas between consecutive "
@@ -424,6 +468,27 @@ def _synthetic_events(path: str, episodes: int = 5):
         emit({"event": "escalation", "ts": base + 4, "run": "selftest",
               "age_s": 0.8, "budget_s": 0.2, "quiet_periods": 2,
               "action": "callback"})
+        # serving events (cli serve / PolicyServer): startup with one
+        # cache hit + one cold bucket, then a final cumulative stats
+        # record — the report must surface rps/p50/p99 and the per-bucket
+        # occupancy + cache-hit pattern
+        emit({"event": "serve_start", "ts": base + 5, "run": "selftest",
+              "tier": "learned", "buckets": [1, 4], "deadline_ms": 5.0,
+              "startup_s": 1.25,
+              "bucket_prepare": {"1": {"cache_hit": True,
+                                       "prepare_s": 0.2},
+                                 "4": {"cache_hit": False,
+                                       "prepare_s": 0.9}},
+              "cache_dir": "/tmp/cache", "fingerprint": "abc"})
+        emit({"event": "serve_stats", "ts": base + 6, "run": "selftest",
+              "tier": "learned", "final": True, "requests": 200,
+              "rps": 512.5, "p50_ms": 1.2, "p99_ms": 7.9, "mean_ms": 1.9,
+              "max_ms": 9.0, "queue_depth": 0,
+              "occupancy": {"1": 40, "4": 160},
+              "buckets": {"1": {"p50_ms": 0.9, "p99_ms": 2.0,
+                                "requests": 40},
+                          "4": {"p50_ms": 1.3, "p99_ms": 7.9,
+                                "requests": 160}}})
         emit({"event": "run_end", "ts": base + episodes + 1,
               "run": "selftest", "status": "ok", "episodes": episodes})
 
@@ -455,6 +520,15 @@ def selftest() -> int:
         assert summary["recovery_totals"] == {
             "dispatch/retry": 1, "learner_state/rollback": 1}, summary
         assert len(summary["escalations"]) == 1, "escalation not surfaced"
+        sv = summary["serving"]
+        assert sv and sv["tier"] == "learned" and sv["requests"] == 200, sv
+        assert sv["rps"] == 512.5 and sv["p99_ms"] == 7.9, \
+            "serving throughput/latency not surfaced"
+        assert sv["occupancy"] == {"1": 40, "4": 160}, sv
+        assert sv["bucket_prepare"]["1"]["cache_hit"] is True \
+            and sv["bucket_prepare"]["4"]["cache_hit"] is False, \
+            "per-bucket cache-hit pattern lost"
+        assert sv["buckets"]["4"]["p99_ms"] == 7.9, sv
         assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
         deltas = phase_deltas([e for e in last_run(load_events(path))
                                if e.get("event") == "episode"])

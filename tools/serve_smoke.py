@@ -1,0 +1,113 @@
+"""Serve smoke: tiny checkpoint -> in-process server -> cache-hit restart.
+
+The CI-stage proof that the serving subsystem's whole lifecycle executes:
+
+1. train a 2-episode tiny checkpoint (triangle network, 8-wide nets);
+2. ``cli serve`` run 1 (cold): N requests through the AOT-compiled policy
+   must exit 0 with zero request errors, a recorded p99 latency, and a
+   compiled-policy artifact written to the cache dir;
+3. ``cli serve`` run 2 (warm): every bucket must report ``cache_hit``
+   (the serialized module was deserialized — the policy was NOT re-traced)
+   and p99 must again be recorded;
+4. the run's events.jsonl must carry ``serve_start`` + a final
+   ``serve_stats`` and end with ``run_end status=ok``.
+
+Run by ``tools/ci_check.sh`` after the chaos stage; standalone:
+
+    JAX_PLATFORMS=cpu python tools/serve_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REQUESTS = 12
+
+
+def _fail(msg: str) -> int:
+    print(f"serve smoke: FAIL — {msg}")
+    return 1
+
+
+def main() -> int:
+    # the chaos stage owns the shared smoke plumbing (cpu pin + repo
+    # .jax_cache persistent-compile settings + tiny config writer)
+    from chaos_smoke import _configure_jax, write_tiny_configs
+
+    _configure_jax()
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+
+    tmp = tempfile.mkdtemp(prefix="gsc_serve_")
+    args = write_tiny_configs(os.path.join(tmp, "cfg"))
+    opts = [a for a in args[4:] if a != "--quiet"]
+
+    r = CliRunner().invoke(cli, [
+        "train", *args, "--episodes", "2",
+        "--result-dir", os.path.join(tmp, "res")])
+    if r.exit_code != 0:
+        print(r.output)
+        return _fail(f"tiny train rc={r.exit_code}")
+    train_out = json.loads(r.output.strip().splitlines()[-1])
+    ckpt = train_out["checkpoint"]
+    if "compile_warmup_s" not in train_out:
+        return _fail("evaluate() lost the compile/warmup split fields")
+
+    serve_args = ["serve", *args[:4], ckpt, *opts,
+                  "--requests", str(REQUESTS), "--concurrency", "4",
+                  "--buckets", "1,4", "--deadline-ms", "2",
+                  "--result-dir", os.path.join(tmp, "serve_res")]
+    outs = []
+    for leg in ("cold", "warm"):
+        rr = CliRunner().invoke(cli, serve_args)
+        if rr.exit_code != 0:
+            print(rr.output)
+            if rr.exception is not None:
+                import traceback
+                traceback.print_exception(type(rr.exception), rr.exception,
+                                          rr.exception.__traceback__)
+            return _fail(f"{leg} serve rc={rr.exit_code}")
+        out = json.loads(rr.output.strip().splitlines()[-1])
+        if out["errors"]:
+            return _fail(f"{leg} serve answered with {out['errors']} "
+                         f"errors: {out['error_detail']}")
+        if not out["p99_ms"] > 0:
+            return _fail(f"{leg} serve recorded no p99 latency: {out}")
+        outs.append(out)
+
+    cold, warm = outs
+    cache_dir = cold["artifact_cache"]
+    blobs = [f for f in os.listdir(cache_dir) if f.endswith(".stablehlo")]
+    if len(blobs) != 2:   # one artifact per bucket
+        return _fail(f"expected 2 compiled-policy artifacts in "
+                     f"{cache_dir}, found {blobs}")
+    cold_hits = [b["cache_hit"] for b in cold["startup"]["buckets"].values()]
+    warm_hits = [b["cache_hit"] for b in warm["startup"]["buckets"].values()]
+    if any(cold_hits) or not all(warm_hits):
+        return _fail(f"cache-hit pattern wrong: cold={cold_hits} "
+                     f"warm={warm_hits}")
+
+    events = [json.loads(line) for line in
+              open(os.path.join(warm["result_dir"], "events.jsonl"))]
+    kinds = [e["event"] for e in events]
+    if "serve_start" not in kinds or "serve_stats" not in kinds:
+        return _fail(f"serve events missing from stream: {kinds}")
+    end = events[-1]
+    if end.get("event") != "run_end" or end.get("status") != "ok":
+        return _fail(f"stream tail {end}")
+
+    print(f"serve smoke: OK — {REQUESTS} requests/leg, cold p99 "
+          f"{cold['p99_ms']} ms @ {cold['rps']} req/s, warm startup "
+          f"{warm['startup']['startup_s']}s with all-bucket cache hits")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
