@@ -157,6 +157,48 @@ def _unroll() -> int:
     return unroll
 
 
+def _mesh():
+    """pjit mesh shape 'DPxMP' of the measured stack (``--mesh`` /
+    GSC_BENCH_MESH; parallel.partition.parse_mesh_shape grammar), or None
+    for the single-device dispatch every earlier round measured.  Each
+    row records the EFFECTIVE value next to pipeline/precision/
+    substep_impl — a multi-chip number without its mesh shape is not
+    attributable.  Validation here is format-only; the worker checks the
+    backend actually HAS dp*mp devices (bench never falls back to a
+    virtual CPU mesh — that would bank a CPU number as a chip rate)."""
+    raw = os.environ.get("GSC_BENCH_MESH", "").strip()
+    if not raw:
+        return None
+    # mirrors parallel.partition.parse_mesh_shape (positive axes only) —
+    # NOT imported here: the orchestrator must stay jax-free so the
+    # parent process never claims the TPU alongside its workers
+    import re
+    if not re.fullmatch(r"[1-9]\d*(?:x[1-9]\d*)?", raw.lower()):
+        raise SystemExit(
+            f"GSC_BENCH_MESH={raw!r} is not 'DPxMP' with positive axes "
+            "(e.g. 8x1, 4x2)")
+    mesh = raw.lower()
+    # canonical DPxMP form: a bare 'N' means mp=1 — every other surface
+    # (cli run_start meta, obs_report, dryrun rows) records 'Nx1', and a
+    # mesh field that splits one shape into two spellings breaks
+    # cross-artifact grouping
+    return mesh if "x" in mesh else f"{mesh}x1"
+
+
+def _partition_rules() -> str:
+    """Partition rulebook under ``--mesh`` (``--partition-rules`` /
+    GSC_BENCH_PARTITION_RULES): 'replicated' (default — params on every
+    device, the bit-identical fallback) or 'sharded' (wide matrices +
+    Adam moments split over mp).  Recorded on rows only when a mesh is
+    set — without one the knob has nothing to partition."""
+    rules = (os.environ.get("GSC_BENCH_PARTITION_RULES", "replicated")
+             .strip() or "replicated")
+    if rules not in ("replicated", "sharded"):
+        raise SystemExit(f"GSC_BENCH_PARTITION_RULES={rules!r} "
+                         "(expected replicated|sharded)")
+    return rules
+
+
 def ladder():
     """The (replicas, chunk, timeout) escalation ladder.  GSC_BENCH_LADDER
     ("B,chunk,timeout[;B,chunk,timeout...]") overrides it — the CPU smoke
@@ -269,7 +311,12 @@ def orchestrate():
                       f"{PROBE_RETRIES} attempts)",
             "unit": "env-steps/s", "retries": 0,
             "pipeline": _pipeline_enabled(), "precision": _precision(),
-            "substep_impl": _substep_impl(), "unroll": _unroll()}))
+            "substep_impl": _substep_impl(), "unroll": _unroll(),
+            "mesh": _mesh(),
+            # same rides-along-with-mesh rule as ok artifacts: a failed
+            # sharded round must not read as a failed replicated one
+            **({"partition_rules": _partition_rules()} if _mesh()
+               else {})}))
         sys.exit(1)
     best = None
     denom = baseline_sps()
@@ -295,6 +342,12 @@ def orchestrate():
             # and the scan-unroll factor actually built into the stack
             "substep_impl": b.get("substep_impl", "xla"),
             "unroll": b.get("unroll", 1),
+            # mesh shape from the worker's banked row (None = the
+            # single-device dispatch); partition_rules rides along only
+            # when a mesh was actually in play
+            "mesh": b.get("mesh"),
+            **({"partition_rules": b["partition_rules"]}
+               if b.get("partition_rules") else {}),
             # transparent retry accounting: 0 for a first-try number
             "retries": b.get("retries", 0),
             # knobs come from the WORKER's banked row — derived from the
@@ -373,7 +426,10 @@ def orchestrate():
             "status": "failed", "reason": "all ladder rungs failed",
             "unit": "env-steps/s", "retries": total_retries,
             "pipeline": _pipeline_enabled(), "precision": _precision(),
-            "substep_impl": _substep_impl(), "unroll": _unroll()}))
+            "substep_impl": _substep_impl(), "unroll": _unroll(),
+            "mesh": _mesh(),
+            **({"partition_rules": _partition_rules()} if _mesh()
+               else {})}))
         sys.exit(1)
     print(artifact(best))
 
@@ -520,13 +576,38 @@ def worker(replicas: int, chunk: int, episodes: int,
                                 substep_impl=substep_impl),
             agent, env.limits)
     B = replicas
+    # pjit mesh (--mesh): the sharded dispatch over a dp x mp device grid.
+    # The backend must genuinely HAVE the devices — make_train_mesh's
+    # virtual-CPU fallback is for dry runs, and a bench row that silently
+    # measured 8 virtual CPU "chips" would bank a lie (the make_mesh
+    # docstring's contract: production entry points check counts first).
+    mesh_spec = _mesh()
+    plan = None
+    partition_rules = None
+    if mesh_spec:
+        from gsc_tpu.parallel import ShardingPlan, parse_mesh_shape
+        dp_, mp_ = parse_mesh_shape(mesh_spec)
+        have = len(jax.devices())
+        if have < dp_ * mp_:
+            raise SystemExit(
+                f"--mesh {mesh_spec} needs {dp_ * mp_} devices, backend "
+                f"has {have} — bench never falls back to a virtual mesh "
+                "(for a CPU smoke set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+        if B % (dp_ * mp_) != 0:
+            raise SystemExit(
+                f"rung replicas ({B}) not divisible by mesh device count "
+                f"({dp_ * mp_}) — pick a GSC_BENCH_LADDER whose B fits "
+                "the mesh")
+        partition_rules = _partition_rules()
+        plan = ShardingPlan.from_spec(mesh_spec, rules=partition_rules)
     # traffic sampled ON DEVICE: at B=256 the old host-stacked schedule was
     # ~90 MB through the tunnel before the first measurement
     dt_sampler = DeviceTraffic(env.sim_cfg, env.service, topo, EPISODE_STEPS)
     traffic = jax.jit(lambda k: dt_sampler.sample_batch(k, B))(
         jax.random.PRNGKey(42))
     jax.block_until_ready(traffic)
-    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True, plan=plan)
 
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
@@ -584,6 +665,9 @@ def worker(replicas: int, chunk: int, episodes: int,
             "replicas": B, "chunk": chunk, "scenario": scenario,
             "pipeline": pipeline, "precision": precision,
             "substep_impl": substep_impl, "unroll": unroll,
+            "mesh": mesh_spec,
+            **({"partition_rules": partition_rules}
+               if partition_rules else {}),
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),
@@ -671,6 +755,33 @@ if __name__ == "__main__":
             raise SystemExit(f"--unroll expects a positive integer, "
                              f"got {val!r}")
         os.environ["GSC_BENCH_SCAN_UNROLL"] = str(unroll)
+        del argv[i:i + 2]
+    if "--mesh" in argv:
+        # forwarded to worker subprocesses via the environment like
+        # --precision; a missing/garbled value must ERROR — a silently
+        # meshless row would mislabel a run meant to measure multi-chip
+        import re as _re
+        i = argv.index("--mesh")
+        mesh = argv[i + 1] if i + 1 < len(argv) else None
+        # positive-axes grammar, kept in sync with _mesh() (see the
+        # jax-free-parent note there)
+        if mesh is None or not _re.fullmatch(r"[1-9]\d*(?:x[1-9]\d*)?",
+                                             mesh.lower()):
+            raise SystemExit(f"--mesh expects 'DPxMP' with positive axes "
+                             f"(e.g. 8x1, 4x2), got {mesh!r}")
+        mesh = mesh.lower()
+        # canonicalize bare 'N' -> 'Nx1' (matches _mesh(); one spelling
+        # per shape across every surface)
+        os.environ["GSC_BENCH_MESH"] = (mesh if "x" in mesh
+                                        else f"{mesh}x1")
+        del argv[i:i + 2]
+    if "--partition-rules" in argv:
+        i = argv.index("--partition-rules")
+        rules = argv[i + 1] if i + 1 < len(argv) else None
+        if rules not in ("replicated", "sharded"):
+            raise SystemExit(f"--partition-rules expects "
+                             f"replicated|sharded, got {rules!r}")
+        os.environ["GSC_BENCH_PARTITION_RULES"] = rules
         del argv[i:i + 2]
     if argv and argv[0] == "--worker":
         worker(int(argv[1]), int(argv[2]), int(argv[3]),

@@ -348,19 +348,37 @@ class DDPG:
                    "actor_grad_norm": optax.global_norm(agrad)}
         return state, metrics
 
-    def _learn_burst(self, state: DDPGState, sample_fn
+    def _learn_burst(self, state: DDPGState, sample_fn, constrain=None
                      ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
         """End-of-episode training: episode_steps gradient steps
         (simple_ddpg.py:307-325) as one fori_loop.  ``sample_fn(key)``
         yields a batch — single-buffer and cross-replica samplers both
-        plug in here."""
+        plug in here.
+
+        ``constrain`` (optional; the sharded multi-chip path) re-pins the
+        carried learner state at the top of every gradient step — without
+        it, GSPMD's fixpoint solve pulls the caller's sharded state
+        layout INTO the loop carry and steps 2..N compute tensor-parallel
+        with carving-dependent reduction order.  ``None`` (the default,
+        every single-agent path) traces the historic body verbatim."""
         rng, sub = jax.random.split(state.rng)
         state = state.replace(rng=sub)
 
         def body(i, carry):
             st, _ = carry
+            if constrain is not None:
+                st = constrain(st)
             batch = sample_fn(jax.random.fold_in(sub, i))
             st, metrics = self.gradient_step_on_batch(st, batch)
+            if constrain is not None:
+                # pin the RETURNED carry too: the constraint on entry
+                # alone leaves the loop's back-edge free for GSPMD to
+                # settle on the caller's sharded layout, which then
+                # back-propagates through the Adam/Polyak updates into
+                # the gradient dots — the update math must stay
+                # replicated end to end, with the single reshard at the
+                # program boundary (out_shardings)
+                st = constrain(st)
             return st, metrics
 
         zero = {"critic_loss": jnp.zeros(()), "actor_loss": jnp.zeros(()),

@@ -674,7 +674,7 @@ class Trainer:
                        init_state: Optional[DDPGState] = None,
                        init_buffers=None, start_episode: int = 0,
                        ckpt_manager=None, ckpt_interval: int = 0,
-                       preempt=None):
+                       preempt=None, plan=None):
         """Replica-parallel training: B vmapped env replicas per episode on
         the scheduled topology, chunked rollouts + end-of-episode learn
         burst (the bench/learning-curve path), logged through the same
@@ -685,6 +685,21 @@ class Trainer:
         The reference has no analogue (one process, one env); evaluation
         and checkpointing consume the resulting learner state exactly like
         the single-env path's.
+
+        ``plan`` (a ``parallel.ShardingPlan``, ``cli train --mesh``):
+        replicas/replay/traffic shard over the plan's dp x mp device grid
+        and the learner state lives in the plan's partition-rule layout
+        between dispatches (ParallelDDPG's sharded dispatch owns the
+        placement — this loop drives it unchanged).  Checkpoints are
+        mesh-shape-AGNOSTIC: every save below gathers the carries to host
+        layout through the plan's gather fns first (orbax 0.7.0 on this
+        box cannot restore sharded layouts portably — host arrays are the
+        format every future mesh can reshard from), and the returned
+        (state, buffers) are host-gathered for the same reason, so the
+        caller's final checkpoint + evaluation never see mesh residency.
+        Elastic resume = restore those host arrays under a DIFFERENT
+        plan: the first dispatch reshards them onto whatever mesh the
+        resuming process built.
 
         Resilience on this path: preemption stop + periodic checkpoints
         (finite-verified host-side — there is no rollback guard here);
@@ -708,7 +723,7 @@ class Trainer:
                                            start_episode=start_episode,
                                            ckpt_manager=ckpt_manager,
                                            ckpt_interval=ckpt_interval,
-                                           preempt=preempt)
+                                           preempt=preempt, plan=plan)
         from ..parallel import ParallelDDPG
         from ..parallel.harness import run_chunked_episodes
         from ..sim.traffic_device import DeviceTraffic
@@ -722,7 +737,17 @@ class Trainer:
                 f"({steps_per_ep})")
         pddpg = ParallelDDPG(self.env, self.agent_cfg,
                              num_replicas=num_replicas, donate=True,
-                             gnn_impl=self.ddpg.actor.gnn_impl)
+                             gnn_impl=self.ddpg.actor.gnn_impl, plan=plan)
+
+        def to_host(state, buffers):
+            """Carries in the mesh-shape-agnostic host layout checkpoints
+            are written in (and the caller receives): the plan's per-leaf
+            gather fns for the learner state, a plain device_get for the
+            replica shards.  Without a plan this is the identity — the
+            historic path hands orbax the live device arrays."""
+            if plan is None:
+                return state, buffers
+            return plan.gather_state(state), jax.device_get(buffers)
         base = jax.random.PRNGKey(self.seed)
         # restored carries must be re-materialized before donation — see
         # train(): donating orbax-restored (host-owned / aliased) buffers
@@ -822,13 +847,16 @@ class Trainer:
                     # with no rollback guard on this path the state must
                     # be verified HERE, or a NaN-poisoned run would
                     # checksum garbage into the last-good resume target.
-                    # One host-side scan at checkpoint cadence (the orbax
-                    # save syncs these leaves anyway).
+                    # One host-side scan at checkpoint cadence (the save
+                    # needs these leaves on host anyway — under a plan
+                    # the gather IS the mesh-agnostic checkpoint layout).
+                    h_state, h_buffers = to_host(state, buffers)
                     if all(np.isfinite(np.asarray(leaf)).all()
-                           for leaf in jax.tree_util.tree_leaves(state)
+                           for leaf in jax.tree_util.tree_leaves(h_state)
                            if np.issubdtype(np.asarray(leaf).dtype,
                                             np.inexact)):
-                        ckpt_manager.save(state, buffers, episode=ep + 1)
+                        ckpt_manager.save(h_state, h_buffers,
+                                          episode=ep + 1)
                     else:
                         self._recover(
                             ep, site="learner_state", action="detected",
@@ -844,7 +872,10 @@ class Trainer:
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()
-        return state, buffers
+        # host layout on the way out (identity without a plan): the
+        # caller's final checkpoint, the preemption snapshot and the
+        # greedy evaluation must never depend on this run's mesh carving
+        return to_host(state, buffers)
 
     def evaluate(self, state: DDPGState, episodes: int = 1,
                  test_mode: bool = True, telemetry: bool = False,

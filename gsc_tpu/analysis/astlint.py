@@ -62,7 +62,12 @@ _WRAPPER_PREFIXES = {"jax", "lax", "nn", "pl", "pallas", "functools",
 # donating entry points (donated_jit call sites in agents/ddpg.py and
 # parallel/dp.py): method name -> (donated call-site positional indices
 # with `self` already bound, donated parameter names, static positional
-# indices exempt from R5)
+# indices exempt from R5).  The pjit-sharded dispatch path
+# (ParallelDDPG._bind_sharded_dispatch) rebinds chunk_step /
+# rollout_episodes / learn_burst with explicit in_/out_shardings but the
+# SAME names, argument orders and donate_argnums as the donated_jit
+# path, so the entries below cover both — a new sharded entry point with
+# a different signature must get its own row here.
 DONATED_SIGS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...],
                               Tuple[int, ...]]] = {
     "episode_step": ((0, 1, 2), ("state", "buffer", "env_state"), (7, 8)),

@@ -245,6 +245,33 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--chunk", default=50, show_default=True,
               help="rollout steps per device call with --replicas > 1 "
                    "(long single-call scans exceed TPU per-call limits)")
+@click.option("--mesh", default=None,
+              help="pjit device mesh 'DPxMP' (e.g. 8x1, 4x2) for "
+                   "--replicas > 1: env replicas/replay/traffic shard "
+                   "over the dp*mp device grid and the learner state "
+                   "follows --partition-rules.  Replica count must be "
+                   "divisible by dp*mp.  The backend must HAVE dp*mp "
+                   "devices (for a CPU dry run preset XLA_FLAGS=--xla_"
+                   "force_host_platform_device_count=N — train never "
+                   "silently re-platforms).  Checkpoints are always "
+                   "host-gathered, so a "
+                   "--resume may use a DIFFERENT mesh shape than the run "
+                   "that wrote them (elastic resume).  Unset: today's "
+                   "single-device dispatch")
+@click.option("--partition-rules", type=click.Choice(["replicated",
+                                                      "sharded"]),
+              default="replicated", show_default=True,
+              help="partition rulebook for the learner state under "
+                   "--mesh: 'replicated' keeps every parameter on every "
+                   "device (bit-identical to 'sharded' on the same mesh; "
+                   "a 1x1 mesh is bit-identical to no --mesh at all, a "
+                   "multi-device mesh drifts ~1e-7 vs the meshless "
+                   "dispatch from fusion-boundary reordering), 'sharded' "
+                   "splits "
+                   "wide actor/critic/GAT matrices + their Adam moments "
+                   "over the mp axis (parallel.partition.sharded_rules) "
+                   "— final learner state stays bit-identical across "
+                   "mesh carvings of the same device count")
 @click.option("--pipeline/--no-pipeline", default=True, show_default=True,
               help="asynchronous episode pipeline (--replicas 1 path): "
                    "background traffic prefetch, fused rollout+learn "
@@ -327,10 +354,10 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          pipeline, precision, substep_impl, unroll, obs_enabled, obs_dir,
-          obs_interval, watchdog_budget, watchdog_escalate,
-          check_invariants, fault_plan, rollback, ckpt_interval,
-          ckpt_retain, jax_cache_dir, verbose):
+          mesh, partition_rules, pipeline, precision, substep_impl, unroll,
+          obs_enabled, obs_dir, obs_interval, watchdog_budget,
+          watchdog_escalate, check_invariants, fault_plan, rollback,
+          ckpt_interval, ckpt_retain, jax_cache_dir, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -355,6 +382,41 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         # same contract as bench.py's --unroll: fail fast with the flag's
         # name, not a SimConfig traceback from deep inside the run loop
         raise click.BadParameter("--unroll must be a positive integer")
+    plan = None
+    if mesh:
+        # build the plan BEFORE any other jax work so the mesh binds the
+        # backend's first-created devices
+        from .parallel import ShardingPlan, parse_mesh_shape
+        if replicas <= 1:
+            raise click.BadParameter(
+                "--mesh shards env replicas over the device grid — it "
+                "requires the replica-parallel path (--replicas > 1)")
+        try:
+            dp_, mp_ = parse_mesh_shape(mesh)
+        except ValueError as e:
+            raise click.BadParameter(str(e))
+        if replicas % (dp_ * mp_) != 0:
+            raise click.BadParameter(
+                f"--replicas ({replicas}) must be divisible by the mesh "
+                f"device count ({dp_ * mp_} = {dp_}x{mp_}) for an even "
+                "replica sharding")
+        # same contract as bench.py and make_train_mesh's docstring:
+        # production entry points check device counts BEFORE building the
+        # mesh — otherwise make_train_mesh's virtual-CPU fallback would
+        # silently re-platform a TPU training run onto dp*mp virtual CPU
+        # devices (the dry-run path must be an explicit choice)
+        have = len(jax.devices())
+        if have < dp_ * mp_:
+            raise click.UsageError(
+                f"--mesh {mesh} needs {dp_ * mp_} devices, backend has "
+                f"{have}.  For a CPU dry run start the process with "
+                f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
+                f"device_count={dp_ * mp_}")
+        plan = ShardingPlan.from_spec(mesh, rules=partition_rules)
+    elif partition_rules != "replicated":
+        raise click.BadParameter(
+            f"--partition-rules {partition_rules} has no effect without "
+            "--mesh — pass --mesh DPxMP (e.g. 4x2) or drop the flag")
     if resume == "auto":
         # newest checksummed checkpoint under the result root that still
         # validates — a corrupted newest (half-written at the kill, bit
@@ -381,7 +443,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
     run_dirs = []
     outputs = {}
     for run in range(runs):
-        plan = FaultPlan.from_env(fault_plan)
+        fplan = FaultPlan.from_env(fault_plan)
         run_seed = seed + run
         if resume:
             # the checkpoint records the precision it was trained under
@@ -423,6 +485,33 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                                     precision=precision,
                                     substep_impl=substep_impl,
                                     unroll=unroll)
+        # episode-0 topology/traffic memo: mesh_meta and the resume
+        # template both need the same deterministic build, and it is
+        # real host work — pay it at most once per run
+        _ep0 = []
+
+        def _episode0():
+            if not _ep0:
+                _ep0.append(driver.episode(0, False))
+            return _ep0[0]
+
+        mesh_meta = {}
+        if plan is not None and obs_enabled:
+            # partition-layout record for run_start: the effective mesh
+            # shape + per-leaf spec counts (never the full tree) over the
+            # eval_shape'd learner state — pure tracing, no device work,
+            # and the SAME summary() the tests assert on.  Gated on obs:
+            # run_start is its only consumer, and the episode(0) traffic
+            # build is real host work a --no-obs run shouldn't pay
+            from .agents.ddpg import DDPG as _DDPG
+            topo0, traffic0 = _episode0()
+            _, obs_shape = jax.eval_shape(
+                env.reset, jax.random.PRNGKey(0), topo0, traffic0)
+            state_shape = jax.eval_shape(
+                _DDPG(env, agent).init, jax.random.PRNGKey(0), obs_shape)
+            mesh_meta = {"mesh": plan.describe(),
+                         "partition_rules": partition_rules,
+                         "partition_specs": plan.summary(state_shape)}
         obs = None
         if obs_enabled:
             from .obs import RunObserver
@@ -447,12 +536,13 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                             "result_dir": rdir,
                             "ckpt_interval": ckpt_interval,
                             "jax_cache_dir": jax_cache_dir,
-                            **({"fault_plan": plan.summary()} if plan
+                            **mesh_meta,
+                            **({"fault_plan": fplan.summary()} if fplan
                                else {})})
         trainer = Trainer(env, driver, agent, seed=run_seed, result_dir=rdir,
                           tensorboard=tensorboard, obs=obs,
                           check_invariants=check_invariants,
-                          fault_plan=plan, rollback=rollback)
+                          fault_plan=fplan, rollback=rollback)
         # checksummed rotating checkpoints under the run dir: periodic
         # (--ckpt-interval) and the SIGTERM/SIGINT snapshot both land
         # here, which is exactly the tree --resume auto searches
@@ -461,7 +551,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         manager = CheckpointManager(os.path.join(rdir, "ckpts"),
                                     retain=ckpt_retain,
                                     meta={"precision": agent.precision},
-                                    fault_plan=plan, obs=obs)
+                                    fault_plan=fplan, obs=obs)
         try:
             # everything from here on runs under the observer: a failed
             # resume restore (or bad --episodes) must still land the
@@ -470,7 +560,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             start_episode = 0
             if resume:
                 from .utils.checkpoint import load_full_or_partial
-                topo0, traffic0 = driver.episode(0, False)
+                topo0, traffic0 = _episode0()
                 _, obs0 = env.reset(jax.random.PRNGKey(0), topo0, traffic0)
                 example = trainer.ddpg.init(jax.random.PRNGKey(0), obs0)
                 if replicas > 1:
@@ -517,7 +607,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                         init_state=init_state, init_buffers=init_buffer,
                         start_episode=start_episode,
                         ckpt_manager=manager, ckpt_interval=ckpt_interval,
-                        preempt=guard)
+                        preempt=guard, plan=plan)
                 else:
                     state, buffer = trainer.train(
                         episodes, verbose=verbose, profile=profile,

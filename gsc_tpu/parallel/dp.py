@@ -42,13 +42,27 @@ class ParallelDDPG:
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
                  num_replicas: int, gnn_impl: str = None,
                  per_replica_topology: bool = False,
-                 sample_mode: str = "across", donate: bool = False):
+                 sample_mode: str = "across", donate: bool = False,
+                 plan=None):
         if sample_mode not in ("across", "local"):
             raise ValueError(f"unknown sample_mode {sample_mode!r}")
         self.env = env
         self.agent = agent
         self.B = num_replicas
         self.sample_mode = sample_mode
+        self.donate = donate
+        # ``plan`` (a partition.ShardingPlan): rebind the three dispatch
+        # entry points with EXPLICIT in_shardings/out_shardings over the
+        # plan's dp x mp mesh — replicas/replay over the whole grid,
+        # learner state per the plan's partition rules.  plan=None is the
+        # no-op fallback: the code path below is byte-identical to the
+        # pre-partition stack.
+        self.plan = plan
+        if plan is not None and num_replicas % plan.n_devices != 0:
+            raise ValueError(
+                f"num_replicas ({num_replicas}) must be divisible by the "
+                f"mesh device count ({plan.n_devices}, mesh "
+                f"{plan.describe()}) for an even replica sharding")
         # the inner DDPG inherits ``donate`` so init() breaks the
         # target-params/params buffer aliasing that donation of the learner
         # state would otherwise trip over (double donation)
@@ -62,7 +76,16 @@ class ParallelDDPG:
         # must treat donated arguments as CONSUMED (always rebind from the
         # return) — the training loops do; comparison-style double-calls
         # on the same inputs must keep the default.
-        if donate:
+        # With per_replica_topology, ``topo`` arguments carry a leading [B]
+        # axis (build with topology.stack_topologies) and every replica
+        # trains on its own network — topology-generalization pressure in
+        # ONE scan, beyond the reference's serial per-episode swapping
+        # (gym_env.py:103-128).
+        self.per_replica_topology = per_replica_topology
+        self._t_ax = 0 if per_replica_topology else None
+        if plan is not None:
+            self._bind_sharded_dispatch()
+        elif donate:
             cls = type(self)
             self.rollout_episodes = donated_jit(
                 self, cls.rollout_episodes, static_argnums=(0, 8),
@@ -73,13 +96,154 @@ class ParallelDDPG:
             self.chunk_step = donated_jit(
                 self, cls.chunk_step, static_argnums=(0, 8, 9),
                 donate_argnums=(1, 2))
-        # With per_replica_topology, ``topo`` arguments carry a leading [B]
-        # axis (build with topology.stack_topologies) and every replica
-        # trains on its own network — topology-generalization pressure in
-        # ONE scan, beyond the reference's serial per-episode swapping
-        # (gym_env.py:103-128).
-        self.per_replica_topology = per_replica_topology
-        self._t_ax = 0 if per_replica_topology else None
+
+    def _bind_sharded_dispatch(self):
+        """Rebind chunk_step / rollout_episodes / learn_burst as sharded
+        jits: explicit ``in_shardings``/``out_shardings`` over the plan's
+        mesh (donation folded in when ``donate=True``).
+
+        The learner-state sharding tree needs the state's pytree
+        structure, which only exists once a state does — so the jits are
+        built LAZILY on the first dispatch and cached; later calls (and
+        every shard/gather move, which is plain ``device_put``) reuse
+        them without retracing.  ``jax.jit`` rejects kwargs when
+        in_shardings is given, so the public wrappers keep the historic
+        keyword signature and forward positionally."""
+        from functools import partial as _partial
+
+        cls = type(self)
+        plan = self.plan
+        data, rep = plan.data_sharding, plan.replicated
+        fns = {}
+
+        def build(state):
+            # ZeRO-style weight sharding: the learner state RESIDES
+            # sharded between dispatches (params + Adam moments split
+            # over mp per the plan's rules — the HBM-residency win), but
+            # the COMPILED PROGRAM only ever sees it replicated: the
+            # wrappers below allgather it with an eager ``device_put``
+            # on the way in and slice it back to shards on the way out
+            # (pure layout moves, never a retrace).  With no mp
+            # annotation inside the program, the partitioned executable
+            # is identical for every carving of the same device count —
+            # which is exactly what makes the final learner state
+            # BIT-identical across mesh shapes.  Keeping params sharded
+            # THROUGH the dots instead (true tensor-parallel compute)
+            # psums the backward dx = dy @ W^T over mp shards in a
+            # carving-dependent order (measured: one gradient step
+            # drifts ~1e-7 per mp size) — a deliberate non-goal until
+            # bit-equality can be traded away.
+            ss = plan.state_shardings(state)
+            fns["_state_shardings"] = ss
+            # dynamic args of all three entry points, in order: state,
+            # buffers, env_states, obs, topo, traffic, start (static
+            # self/num_steps/learn are excluded from in_shardings)
+            arg_sh = (rep, data, data, data, rep, data, rep)
+
+            def shard_jit(method, static, donate_pos, n_in, out_sh):
+                fn = getattr(method, "__wrapped__", method)
+                return _partial(jax.jit(
+                    fn, static_argnums=static,
+                    donate_argnums=donate_pos if self.donate else (),
+                    in_shardings=arg_sh[:n_in], out_shardings=out_sh),
+                    self)
+
+            fns["chunk_step"] = shard_jit(
+                cls.chunk_step, (0, 8, 9), (1, 2), 7,
+                (rep, data, data, data, rep, rep))
+            fns["rollout_episodes"] = shard_jit(
+                cls.rollout_episodes, (0, 8), (2,), 7,
+                (rep, data, data, data, rep))
+            fns["learn_burst"] = shard_jit(
+                cls.learn_burst, (0,), (1,), 2, (rep, rep))
+            return fns
+
+        def gather_in(state):
+            # entry allgather: ss -> replicated (no-op for a state that
+            # is already replicated, e.g. the first dispatch)
+            return jax.device_put(state, rep)
+
+        def shard_out(state):
+            # exit slice: replicated -> the plan's sharded residency
+            return jax.device_put(state, fns["_state_shardings"])
+
+        # entry placement for the data/replicated pytrees: this jax
+        # version does NOT auto-reshard committed arguments that mismatch
+        # in_shardings, and callers legitimately hand over single-device
+        # pytrees (reset_all outputs, host-staged traffic, a restored
+        # replay) — an eager device_put is a no-op for an already-placed
+        # carry (same buffers back, so donation still consumes the
+        # original) and a layout move exactly once otherwise.  This is
+        # what lets Trainer/harness code drive the sharded path with ZERO
+        # call-site changes.  Carries the caller rebinds from our outputs
+        # (buffers/env_states/obs) are already placed, so their device_put
+        # is free; topo/traffic arrive as the SAME host object every chunk
+        # call of an episode — a small keep-alive memo makes their
+        # placement once-per-object instead of once-per-call.
+        from collections import OrderedDict
+        memo = OrderedDict()
+
+        def put_once(tree, sh):
+            key = id(tree)
+            hit = memo.get(key)
+            if hit is not None and hit[0] is tree and hit[1] is sh:
+                return hit[2]
+            out = jax.device_put(tree, sh)
+            # the retained `tree` ref keeps the id from being recycled;
+            # the bound keeps a long run from accumulating every
+            # episode's host traffic
+            memo[key] = (tree, sh, out)
+            while len(memo) > 8:
+                memo.popitem(last=False)
+            return out
+
+        def put_data(tree):
+            # rebound carries (buffers/env_states/obs): placed after the
+            # first call, so no memo — memoizing DONATED trees would pin
+            # consumed buffers alive
+            return jax.device_put(tree, data)
+
+        # every dispatch (where a compile, or a recompile after cache
+        # eviction, can happen) runs under the multi-device-CPU guard:
+        # deserializing num_partitions>1 CPU executables from the
+        # persistent compilation cache heap-corrupts or silently
+        # miscomputes on this jax version (see partition.py) — the
+        # in-memory executable is unaffected, so steady-state calls pay
+        # two config reads and nothing else
+        from .partition import no_persistent_compile_cache
+
+        def chunk_step(state, buffers, env_states, obs, topo, traffic,
+                       episode_start_step, num_steps=None, learn=False):
+            fn = fns.get("chunk_step") or build(state)["chunk_step"]
+            with no_persistent_compile_cache(plan.mesh):
+                out = fn(gather_in(state), put_data(buffers),
+                         put_data(env_states), put_data(obs),
+                         put_once(topo, rep), put_once(traffic, data),
+                         jax.device_put(episode_start_step, rep),
+                         num_steps, learn)
+            return (shard_out(out[0]),) + out[1:]
+
+        def rollout_episodes(state, buffers, env_states, obs, topo,
+                             traffic, episode_start_step, num_steps=None):
+            fn = (fns.get("rollout_episodes")
+                  or build(state)["rollout_episodes"])
+            with no_persistent_compile_cache(plan.mesh):
+                out = fn(gather_in(state), put_data(buffers),
+                         put_data(env_states), put_data(obs),
+                         put_once(topo, rep), put_once(traffic, data),
+                         jax.device_put(episode_start_step, rep),
+                         num_steps)
+            return (shard_out(out[0]),) + out[1:]
+
+        def learn_burst(state, buffers):
+            fn = fns.get("learn_burst") or build(state)["learn_burst"]
+            with no_persistent_compile_cache(plan.mesh):
+                out = fn(gather_in(state), put_data(buffers))
+            return (shard_out(out[0]),) + out[1:]
+
+        self.chunk_step = chunk_step
+        self.rollout_episodes = rollout_episodes
+        self.learn_burst = learn_burst
 
     # ----------------------------------------------------------------- init
     def init(self, rng, sample_obs) -> DDPGState:
@@ -237,10 +401,39 @@ class ParallelDDPG:
             sampler = (self._sample_local if self.sample_mode == "local"
                        else self._sample_across)
             state, metrics = self.ddpg._learn_burst(
-                state, lambda k: sampler(buffers, k))
+                state, self._batch_sampler(sampler, buffers),
+                constrain=self._state_constraint())
         return state, buffers, env_states, obs, stats, metrics
 
     # ------------------------------------------------------------- learning
+    def _state_constraint(self):
+        """Per-gradient-step learner-state re-pin for ``_learn_burst``:
+        under a plan the loop carry is constraint-gathered to replicated
+        at the top of every step (see the sharded-dispatch ZeRO note),
+        keeping every gradient step's math canonical; None without a
+        plan — the historic trace, byte for byte."""
+        if self.plan is None:
+            return None
+        rep = self.plan.replicated
+        return lambda st: jax.lax.with_sharding_constraint(st, rep)
+
+    def _batch_sampler(self, sampler, buffers: ReplayBuffer):
+        """``sample_fn(key)`` for the learn burst.  Under a sharding plan
+        the sampled batch is constraint-REPLICATED before any gradient
+        math touches it: every batch contraction (loss mean, dW) then
+        runs in canonical full-batch order identically on every device,
+        so the learner state stays BIT-identical across mesh carvings —
+        a batch left sharded would psum per-shard partial sums in a
+        carving-dependent (dp-then-mp) order.  The gather this buys is
+        one micro-batch per gradient step, orders of magnitude smaller
+        than the replay shards that stay distributed.  Without a plan
+        this is a no-op passthrough (the pre-partition stack verbatim)."""
+        if self.plan is None:
+            return lambda k: sampler(buffers, k)
+        rep = self.plan.replicated
+        return lambda k: jax.lax.with_sharding_constraint(
+            sampler(buffers, k), rep)
+
     def _sample_across(self, buffers: ReplayBuffer, key):
         """Uniform batch over (replica, slot) pairs from all shards —
         exact single-agent semantics, but the gather touches every shard:
@@ -279,4 +472,5 @@ class ParallelDDPG:
         sampler = (self._sample_local if self.sample_mode == "local"
                    else self._sample_across)
         return self.ddpg._learn_burst(
-            state, lambda k: sampler(buffers, k))
+            state, self._batch_sampler(sampler, buffers),
+            constrain=self._state_constraint())

@@ -36,6 +36,15 @@ echo "== serve smoke (AOT policy serving: cold compile -> cache-hit restart) =="
 # cache on every bucket (tools/serve_smoke.py asserts rc, events, hits)
 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
+echo "== multihost smoke (pjit carving bit-equality: replicated vs sharded) =="
+# two fresh-subprocess carving legs over the same 8 virtual CPU devices —
+# one with every param replicated, one with wide matrices genuinely split
+# over mp — must land BIT-identical final learner states (the tool exits
+# nonzero on digest divergence, a failed leg, or a wedged backend, with
+# structured {"status":"failed","reason":...} rows, never a bare tail)
+env JAX_PLATFORMS=cpu python tools/dryrun_multihost.py --mesh-matrix \
+    --legs "8x1:replicated,4x2:sharded" --leg-timeout 420
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -214,6 +214,14 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     if run_start is not None and run_start.get("substep_impl"):
         engine = {"substep_impl": run_start["substep_impl"],
                   "unroll": run_start.get("unroll", 1)}
+    # mesh header fields (run_start meta, cli train --mesh): the DPxMP
+    # carving, the partition rulebook and the compact per-leaf spec
+    # counts, so a multi-chip run's layout is readable off the report
+    mesh = None
+    if run_start is not None and run_start.get("mesh"):
+        mesh = {"mesh": run_start["mesh"],
+                "partition_rules": run_start.get("partition_rules"),
+                "partition_specs": run_start.get("partition_specs") or {}}
     # serving section (cli serve runs): the final serve_stats event holds
     # the cumulative numbers; serve_start carries startup + cache hits
     serve_start = next((e for e in events
@@ -244,6 +252,7 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "status": (last_run_end or {}).get("status"),
         "precision": precision,
         "engine": engine,
+        "mesh": mesh,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -301,6 +310,13 @@ def render_text(summary: Dict, out=sys.stdout):
     if eng:
         w(f"substep: {eng.get('substep_impl')}  "
           f"unroll: {eng.get('unroll')}\n")
+    mesh = summary.get("mesh")
+    if mesh:
+        specs = mesh.get("partition_specs") or {}
+        spec_txt = ", ".join(f"{k} x{v}" for k, v in specs.items())
+        w(f"mesh: {mesh.get('mesh')}  rules: "
+          f"{mesh.get('partition_rules')}"
+          + (f"  ({spec_txt})" if spec_txt else "") + "\n")
     if summary.get("runs_in_stream", 1) > 1:
         w(f"(stream holds {summary['runs_in_stream']} appended runs — "
           "showing the last)\n")
@@ -405,7 +421,10 @@ def _synthetic_events(path: str, episodes: int = 5):
 
         emit({"event": "run_start", "ts": base, "run": "selftest",
               "episodes": episodes, "precision": "bf16",
-              "substep_impl": "pallas", "unroll": 2})
+              "substep_impl": "pallas", "unroll": 2,
+              "mesh": "4x2", "partition_rules": "sharded",
+              "partition_specs": {"PartitionSpec()": 87,
+                                  "PartitionSpec(None, 'mp')": 44}})
         # the dtype-gauge event the trainer emits via record_precision
         emit({"event": "precision", "ts": base, "run": "selftest",
               "name": "bf16", "param_dtype": "float32",
@@ -506,6 +525,16 @@ def selftest() -> int:
         assert summary["engine"] == {
             "substep_impl": "pallas", "unroll": 2}, \
             "engine-knob header not surfaced"
+        assert summary["mesh"] == {
+            "mesh": "4x2", "partition_rules": "sharded",
+            "partition_specs": {"PartitionSpec()": 87,
+                                "PartitionSpec(None, 'mp')": 44}}, \
+            "mesh header not surfaced"
+        import io
+        txt = io.StringIO()
+        render_text(summary, out=txt)
+        assert "mesh: 4x2  rules: sharded" in txt.getvalue(), \
+            "mesh header line not rendered"
         assert len(summary["stalls"]) == 1, "stall not surfaced"
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1
