@@ -185,6 +185,18 @@ def _mesh():
     return mesh if "x" in mesh else f"{mesh}x1"
 
 
+def _topo_mix():
+    """Mixed-topology batch spec of the measured stack (``--topo-mix`` /
+    GSC_BENCH_TOPO_MIX; topology.scenarios mix grammar, registry names
+    only — bench has no scheduler to expand 'schedule' from), or None for
+    the homogeneous batch every earlier round measured.  Validation here
+    is presence-only — the orchestrator stays jax-free, so the grammar/
+    registry check happens in the worker (a bad mix fails the rung with
+    its parse error, never banks a mislabeled row)."""
+    raw = os.environ.get("GSC_BENCH_TOPO_MIX", "").strip()
+    return raw or None
+
+
 def _partition_rules() -> str:
     """Partition rulebook under ``--mesh`` (``--partition-rules`` /
     GSC_BENCH_PARTITION_RULES): 'replicated' (default — params on every
@@ -312,7 +324,7 @@ def orchestrate():
             "unit": "env-steps/s", "retries": 0,
             "pipeline": _pipeline_enabled(), "precision": _precision(),
             "substep_impl": _substep_impl(), "unroll": _unroll(),
-            "mesh": _mesh(),
+            "mesh": _mesh(), "topo_mix": _topo_mix(),
             # same rides-along-with-mesh rule as ok artifacts: a failed
             # sharded round must not read as a failed replicated one
             **({"partition_rules": _partition_rules()} if _mesh()
@@ -346,6 +358,12 @@ def orchestrate():
             # single-device dispatch); partition_rules rides along only
             # when a mesh was actually in play
             "mesh": b.get("mesh"),
+            # mixed-topology batch spec from the worker's banked row
+            # (None = homogeneous): a mixed-batch rate without its mix is
+            # not comparable to the homogeneous rows around it
+            "topo_mix": b.get("topo_mix"),
+            **({"jit_traces": b["jit_traces"]} if b.get("jit_traces")
+               else {}),
             **({"partition_rules": b["partition_rules"]}
                if b.get("partition_rules") else {}),
             # transparent retry accounting: 0 for a first-try number
@@ -427,7 +445,7 @@ def orchestrate():
             "unit": "env-steps/s", "retries": total_retries,
             "pipeline": _pipeline_enabled(), "precision": _precision(),
             "substep_impl": _substep_impl(), "unroll": _unroll(),
-            "mesh": _mesh(),
+            "mesh": _mesh(), "topo_mix": _topo_mix(),
             **({"partition_rules": _partition_rules()} if _mesh()
                else {})}))
         sys.exit(1)
@@ -601,13 +619,45 @@ def worker(replicas: int, chunk: int, episodes: int,
                 "the mesh")
         partition_rules = _partition_rules()
         plan = ShardingPlan.from_spec(mesh_spec, rules=partition_rules)
+    # mixed-topology batch (--topo-mix): the B axis carries a round-robin
+    # of registry scenarios padded into the measured stack's bucket — ONE
+    # vmapped program serves the whole mixture, which is exactly the claim
+    # the MIXTOPO artifact quantifies against the homogeneous rows
+    topo_mix = _topo_mix()
+    mix_plan = None
+    mix_samplers = None
+    if topo_mix:
+        from gsc_tpu.topology import DEFAULT_REGISTRY, TopologyBucket
+        from gsc_tpu.topology.scenarios import (build_mix_entries,
+                                                mix_device_samplers,
+                                                plan_mix,
+                                                sample_mix_device)
+        bucket = TopologyBucket(env.limits.max_nodes, env.limits.max_edges)
+        entries = build_mix_entries(topo_mix, DEFAULT_REGISTRY, bucket,
+                                    dt=env.sim_cfg.dt)
+        mix_plan = plan_mix(entries, B, bucket, env.sim_cfg, EPISODE_STEPS)
+        topo = mix_plan.topo
+    # retrace accounting for the banked rows: mixed vs homogeneous rows
+    # must show the SAME trace counts for the dispatch entry points — the
+    # mixture is batch data, not a compile axis
+    from gsc_tpu.analysis.sentinels import CompileMonitor
+    monitor = CompileMonitor().start()
     # traffic sampled ON DEVICE: at B=256 the old host-stacked schedule was
     # ~90 MB through the tunnel before the first measurement
-    dt_sampler = DeviceTraffic(env.sim_cfg, env.service, topo, EPISODE_STEPS)
-    traffic = jax.jit(lambda k: dt_sampler.sample_batch(k, B))(
-        jax.random.PRNGKey(42))
+    if mix_plan is not None:
+        mix_samplers = mix_device_samplers(mix_plan, env.sim_cfg,
+                                           env.service, EPISODE_STEPS)
+        traffic = jax.jit(
+            lambda k: sample_mix_device(mix_plan, mix_samplers, k))(
+            jax.random.PRNGKey(42))
+    else:
+        dt_sampler = DeviceTraffic(env.sim_cfg, env.service, topo,
+                                   EPISODE_STEPS)
+        traffic = jax.jit(lambda k: dt_sampler.sample_batch(k, B))(
+            jax.random.PRNGKey(42))
     jax.block_until_ready(traffic)
-    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True, plan=plan)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True, plan=plan,
+                         per_replica_topology=mix_plan is not None)
 
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
@@ -665,9 +715,19 @@ def worker(replicas: int, chunk: int, episodes: int,
             "replicas": B, "chunk": chunk, "scenario": scenario,
             "pipeline": pipeline, "precision": precision,
             "substep_impl": substep_impl, "unroll": unroll,
-            "mesh": mesh_spec,
+            "mesh": mesh_spec, "topo_mix": topo_mix,
             **({"partition_rules": partition_rules}
                if partition_rules else {}),
+            # traces per dispatch entry point since process start
+            # (analysis.sentinels.CompileMonitor): the compile-count half
+            # of the MIXTOPO mixed-vs-homogeneous comparison.  Only the
+            # episode-loop entry points — the monitor also counts every
+            # jitted helper (hundreds of one-shot build-time traces),
+            # which would bloat the row without informing the comparison.
+            "jit_traces": {fn: t for fn, (t, _c)
+                           in monitor.snapshot().items() if t and fn in
+                           ("chunk_step", "rollout_episodes",
+                            "learn_burst", "reset_all")},
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),
@@ -782,6 +842,18 @@ if __name__ == "__main__":
             raise SystemExit(f"--partition-rules expects "
                              f"replicated|sharded, got {rules!r}")
         os.environ["GSC_BENCH_PARTITION_RULES"] = rules
+        del argv[i:i + 2]
+    if "--topo-mix" in argv:
+        # forwarded via the environment like --precision; a missing value
+        # must ERROR — a silently-homogeneous row would mislabel a run
+        # meant to measure the mixture.  Full grammar/registry validation
+        # happens in the worker (the parent stays jax-free).
+        i = argv.index("--topo-mix")
+        mix = argv[i + 1] if i + 1 < len(argv) else None
+        if not mix or mix.startswith("--"):
+            raise SystemExit(f"--topo-mix expects a mix spec (topology."
+                             f"scenarios grammar), got {mix!r}")
+        os.environ["GSC_BENCH_TOPO_MIX"] = mix
         del argv[i:i + 2]
     if argv and argv[0] == "--worker":
         worker(int(argv[1]), int(argv[2]), int(argv[3]),

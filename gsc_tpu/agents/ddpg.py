@@ -156,6 +156,11 @@ class DDPG:
             "action": action,
             "reward": jnp.zeros(()),
             "done": jnp.zeros(()),
+            # which network the transition was collected on (the
+            # Topology's topo_id: schedule position, or mix-entry index
+            # in mixed-topology batches) — 4 bytes/transition, lets
+            # replay analysis attribute cross-topology experience
+            "topo_idx": jnp.zeros((), jnp.int32),
         }
 
     def init_buffer(self, sample_obs) -> ReplayBuffer:
@@ -229,6 +234,7 @@ class DDPG:
             buffer = buffer_add(buffer, {
                 "obs": obs, "next_obs": next_obs, "action": action,
                 "reward": reward, "done": done.astype(jnp.float32),
+                "topo_idx": topo.topo_id,
             })
             stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
                      "avg_e2e_delay": info["avg_e2e_delay"]}

@@ -391,6 +391,13 @@ class Trainer:
         - ``preempt`` (a resilience.PreemptionGuard) stops the loop at the
           next episode boundary after SIGTERM/SIGINT — the caller then
           snapshots ``(state, buffer)`` at ``self.completed_episodes``."""
+        if getattr(self.driver, "topo_mix", None):
+            # the mix fills a replica axis this path does not have —
+            # silently training one topology would fake mixture coverage
+            raise ValueError(
+                "topo_mix needs the replica-parallel path "
+                "(train_parallel / --replicas > 1); the single-env loop "
+                "has no batch axis to fill with the mixture")
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
@@ -735,9 +742,22 @@ class Trainer:
             raise ValueError(
                 f"chunk ({chunk}) must divide episode_steps "
                 f"({steps_per_ep})")
+        # mixed-topology batches (EpisodeDriver(topo_mix=...)): the B axis
+        # carries a round-robin of the schedule's networks + registry
+        # scenarios instead of one topology — per_replica_topology threads
+        # the stacked [B] topology pytree through the vmapped dispatch, so
+        # topology diversity fills the batch instead of costing wall-clock
+        # episodes, and a "schedule switch" never recompiles (the switch
+        # IS the per-replica topology tensor)
+        mix_plan = (self.driver.mix_plan(num_replicas)
+                    if getattr(self.driver, "topo_mix", None) else None)
+        if mix_plan is not None:
+            from ..topology.scenarios import (mix_device_samplers,
+                                              sample_mix_device)
         pddpg = ParallelDDPG(self.env, self.agent_cfg,
                              num_replicas=num_replicas, donate=True,
-                             gnn_impl=self.ddpg.actor.gnn_impl, plan=plan)
+                             gnn_impl=self.ddpg.actor.gnn_impl, plan=plan,
+                             per_replica_topology=mix_plan is not None)
 
         def to_host(state, buffers):
             """Carries in the mesh-shape-agnostic host layout checkpoints
@@ -766,10 +786,25 @@ class Trainer:
             pddpg.init_buffers(one_obs)
 
         # one on-device sampler per scheduled topology (the scheduler
-        # cycles training_network_files every `period` episodes)
+        # cycles training_network_files every `period` episodes); mixed
+        # runs instead build one sampler per MIX ENTRY (each with its
+        # scenario's traffic shape / fault tables) and interleave the
+        # per-entry draws back into replica order
         samplers = {}
+        mix_samplers = None
 
         def episode_traffic(ep, topo):
+            nonlocal mix_samplers
+            if mix_plan is not None:
+                if not device_traffic:
+                    return self.driver.mix_traffic(ep, mix_plan)
+                if mix_samplers is None:
+                    mix_samplers = mix_device_samplers(
+                        mix_plan, self.env.sim_cfg, self.env.service,
+                        steps_per_ep, default_trace=self.driver.trace)
+                return sample_mix_device(
+                    mix_plan, mix_samplers,
+                    jax.random.fold_in(base, 2000 + ep))
             if not device_traffic:
                 stacked = [self.driver.traffic_for(
                     ep, topo, seed=self.driver.base_seed + 1000 * ep + r)
@@ -808,14 +843,21 @@ class Trainer:
                         detail=f"stopping before episode {ep}; the caller "
                                "checkpoints the drained state")
                     break
-                topo = self.driver.topology_for(ep)
+                # mixed mode: the stacked topology is the SAME pytree
+                # object every episode (driver memo), so the device
+                # placement memo and the compiled program both hit — the
+                # whole mixture trains with exactly one trace
+                topo = (mix_plan.topo if mix_plan is not None
+                        else self.driver.topology_for(ep))
                 traffic = episode_traffic(ep, topo)
                 if self.obs:
                     self.obs.episode_dispatched(ep)
                 state, buffers, rets, succ, final = run_chunked_episodes(
                     pddpg, topo, lambda _: traffic, state, buffers,
                     1, steps_per_ep, chunk, self.seed + ep,
-                    step_offset=ep * steps_per_ep, hub=hub, timer=timer)
+                    step_offset=ep * steps_per_ep, hub=hub, timer=timer,
+                    topo_names=(mix_plan.names if mix_plan is not None
+                                else None))
                 sps = ((ep - start_episode + 1) * steps_per_ep
                        * num_replicas / (time.time() - start))
                 row = {"episodic_return": rets[0],

@@ -179,7 +179,7 @@ def init_configs(out: str):
 
 def _build(agent_config, simulator_config, service, scheduler, seed,
            max_nodes, max_edges, resource_functions_path=None,
-           precision=None, substep_impl=None, unroll=None):
+           precision=None, substep_impl=None, unroll=None, topo_mix=None):
     from .config.loader import load_agent, load_scheduler, load_service, load_sim
     from .config.schema import EnvLimits
     from .env.driver import EpisodeDriver
@@ -205,7 +205,7 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
     env = ServiceCoordEnv(svc, sim_cfg, agent, limits)
     driver = EpisodeDriver(sched, sim_cfg, svc, agent.episode_steps,
                            max_nodes=max_nodes, max_edges=max_edges,
-                           base_seed=seed)
+                           base_seed=seed, topo_mix=topo_mix)
     return env, driver, agent
 
 
@@ -272,6 +272,22 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "over the mp axis (parallel.partition.sharded_rules) "
                    "— final learner state stays bit-identical across "
                    "mesh carvings of the same device count")
+@click.option("--topo-mix", default=None,
+              help="mixed-topology batched training (--replicas > 1): "
+                   "fill the replica axis with a round-robin of this "
+                   "comma-separated mix instead of one network per "
+                   "episode.  Entries: 'schedule' (expands to the "
+                   "scheduler's training topologies) or a scenario-"
+                   "registry name (abilene, triangle, bteurope, ..., "
+                   "random<N>/star<N>/ring<N>/line<N>), each optionally "
+                   "'+<shape>' (bursty|diurnal|flash_crowd traffic), "
+                   "'~<site>@<interval>[.<index>]' capacity faults "
+                   "(link/node, '&'-joined), ':<seed>' (randomized "
+                   "generators only).  Example: "
+                   "'schedule,abilene+bursty,random12~link@3.0:7'.  One "
+                   "compiled program serves the whole mixture — the "
+                   "schedule 'switch' is just per-replica topology data, "
+                   "so nothing retraces")
 @click.option("--pipeline/--no-pipeline", default=True, show_default=True,
               help="asynchronous episode pipeline (--replicas 1 path): "
                    "background traffic prefetch, fused rollout+learn "
@@ -354,10 +370,10 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           result_dir, experiment_id, max_nodes, max_edges, tensorboard,
           profile, runs, resume, resource_functions_path, replicas, chunk,
-          mesh, partition_rules, pipeline, precision, substep_impl, unroll,
-          obs_enabled, obs_dir, obs_interval, watchdog_budget,
-          watchdog_escalate, check_invariants, fault_plan, rollback,
-          ckpt_interval, ckpt_retain, jax_cache_dir, verbose):
+          mesh, partition_rules, topo_mix, pipeline, precision,
+          substep_impl, unroll, obs_enabled, obs_dir, obs_interval,
+          watchdog_budget, watchdog_escalate, check_invariants, fault_plan,
+          rollback, ckpt_interval, ckpt_retain, jax_cache_dir, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -417,6 +433,19 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         raise click.BadParameter(
             f"--partition-rules {partition_rules} has no effect without "
             "--mesh — pass --mesh DPxMP (e.g. 4x2) or drop the flag")
+    if topo_mix:
+        if replicas <= 1:
+            raise click.BadParameter(
+                "--topo-mix fills the replica axis with the mixture — it "
+                "requires the replica-parallel path (--replicas > 1)")
+        # grammar + registry-name validation BEFORE any expensive build;
+        # size/fit errors (a 53-node tinet in a 24-node bucket) surface
+        # from the driver's compile with the bucket dims in the message
+        from .topology.scenarios import DEFAULT_REGISTRY
+        try:
+            DEFAULT_REGISTRY.parse_mix(topo_mix)
+        except ValueError as e:
+            raise click.BadParameter(f"--topo-mix: {e}")
     if resume == "auto":
         # newest checksummed checkpoint under the result root that still
         # validates — a corrupted newest (half-written at the kill, bit
@@ -484,7 +513,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                                     resource_functions_path,
                                     precision=precision,
                                     substep_impl=substep_impl,
-                                    unroll=unroll)
+                                    unroll=unroll, topo_mix=topo_mix)
         # episode-0 topology/traffic memo: mesh_meta and the resume
         # template both need the same deterministic build, and it is
         # real host work — pay it at most once per run
@@ -527,6 +556,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
+                            "topo_mix": topo_mix,
                             "precision": agent.precision,
                             # the EFFECTIVE engine knobs (yaml or flag),
                             # read back from the built sim_cfg so the

@@ -10,6 +10,7 @@ each episode gets a freshly sampled ``TrafficSchedule``.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, List, Optional, Sequence
@@ -19,8 +20,10 @@ import numpy as np
 from ..config.schema import SchedulerConfig, ServiceConfig, SimConfig
 from ..sim.state import TrafficSchedule
 from ..sim.traffic import TraceEvents, generate_traffic, traffic_capacity
-from ..topology.compiler import (Topology, check_dt_quantization,
-                                 load_topology)
+from ..topology import scenarios
+from ..topology.compiler import (Topology, TopologyBucket,
+                                 check_dt_quantization,
+                                 load_topology_cached)
 
 
 def _node_index(name: str) -> int:
@@ -38,23 +41,41 @@ class EpisodeDriver:
                  max_nodes: int = 24, max_edges: int = 37,
                  base_seed: int = 0,
                  topologies: Optional[Sequence[Topology]] = None,
-                 inference_topology: Optional[Topology] = None):
+                 inference_topology: Optional[Topology] = None,
+                 topo_mix: Optional[str] = None,
+                 registry: Optional["scenarios.ScenarioRegistry"] = None):
         self.scheduler = scheduler
         self.sim_cfg = sim_cfg
         self.service = service
         self.episode_steps = episode_steps
         self.base_seed = base_seed
         if topologies is None:
+            # memoized per (file, mtime, dims, cap overrides, seed):
+            # schedule rebuilds and --runs legs reuse the compiled pytree
+            # instead of re-parsing + re-shortest-pathing every network
+            # topo_id = schedule position, stamped inside the memo so a
+            # rebuilt driver (--runs legs, schedule switches) gets the
+            # SAME object back for every position
             topologies = [
-                load_topology(p, max_nodes=max_nodes, max_edges=max_edges,
-                              force_link_cap=sim_cfg.force_link_cap,
-                              force_node_cap=sim_cfg.force_node_cap,
-                              seed=base_seed)
-                for p in scheduler.training_network_files
+                load_topology_cached(
+                    p, max_nodes=max_nodes, max_edges=max_edges,
+                    force_link_cap=sim_cfg.force_link_cap,
+                    force_node_cap=sim_cfg.force_node_cap,
+                    seed=base_seed, topo_id=i)
+                for i, p in enumerate(scheduler.training_network_files)
             ]
-        self.topologies: List[Topology] = list(topologies)
+        # schedule topologies carry their schedule position as topo_id so
+        # replay transitions record which network they were collected on
+        # (mixed batches re-stamp per mix-entry position instead); loaded
+        # topologies arrive pre-stamped, caller-passed lists get stamped
+        # here
+        import jax.numpy as jnp
+        self.topologies: List[Topology] = [
+            t if int(np.asarray(t.topo_id)) == i
+            else t.replace(topo_id=jnp.asarray(i, jnp.int32))
+            for i, t in enumerate(topologies)]
         if inference_topology is None:
-            inference_topology = load_topology(
+            inference_topology = load_topology_cached(
                 scheduler.inference_network, max_nodes=max_nodes,
                 max_edges=max_edges, force_link_cap=sim_cfg.force_link_cap,
                 force_node_cap=sim_cfg.force_node_cap, seed=base_seed)
@@ -67,6 +88,49 @@ class EpisodeDriver:
         max_ing = max(int(np.asarray(t.is_ingress).sum()) for t in
                       self.topologies + [self.inference_topology])
         self.capacity = traffic_capacity(sim_cfg, max_ing, episode_steps)
+        # ---- mixed-topology batch mode (topology.scenarios) -------------
+        # ``topo_mix`` turns the schedule-of-topologies into PER-BATCH
+        # diversity: mix_plan(B) fills the replica axis round-robin over
+        # the expanded entry list (schedule networks + registry
+        # scenarios), all padded into one shape bucket — a single vmapped
+        # dispatch then trains every mixture member side by side with ONE
+        # compiled program.
+        self.topo_mix = topo_mix
+        self.registry = registry or scenarios.DEFAULT_REGISTRY
+        self.bucket = TopologyBucket(max_nodes, max_edges)
+        self._mix_entries = None
+        self._mix_plans = {}
+        if topo_mix:
+            sched_names = [os.path.basename(p) for p in
+                           scheduler.training_network_files]
+            self._mix_entries = scenarios.build_mix_entries(
+                topo_mix, self.registry, self.bucket,
+                schedule_topos=self.topologies,
+                schedule_names=sched_names, dt=sim_cfg.dt)
+
+    # ------------------------------------------------------------ mix mode
+    def mix_plan(self, num_replicas: int) -> "scenarios.MixPlan":
+        """Round-robin MixPlan for ``num_replicas`` (memoized per B —
+        the stacked topology is the SAME object every episode, so the
+        vmapped dispatch never re-places or retraces it)."""
+        if not self.topo_mix:
+            raise ValueError("driver has no topo_mix configured")
+        plan = self._mix_plans.get(num_replicas)
+        if plan is None:
+            plan = scenarios.plan_mix(self._mix_entries, num_replicas,
+                                      self.bucket, self.sim_cfg,
+                                      self.episode_steps)
+            self._mix_plans[num_replicas] = plan
+        return plan
+
+    def mix_traffic(self, episode: int,
+                    plan: "scenarios.MixPlan") -> TrafficSchedule:
+        """[B]-stacked host traffic for one mixed episode (per-replica
+        seeds follow the replica-parallel trainer's convention)."""
+        return scenarios.mix_traffic_host(
+            plan, self.sim_cfg, self.service, self.episode_steps,
+            seed_for=lambda r: self.base_seed + 1000 * episode + r,
+            default_trace=self.trace)
 
     def topology_for(self, episode: int, test_mode: bool = False) -> Topology:
         """Topology schedule (gym_env.py:103-128): switch every ``period``

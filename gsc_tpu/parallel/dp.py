@@ -114,6 +114,7 @@ class ParallelDDPG:
         cls = type(self)
         plan = self.plan
         data, rep = plan.data_sharding, plan.replicated
+        topo_sh = data if self.per_replica_topology else rep
         fns = {}
 
         def build(state):
@@ -137,8 +138,11 @@ class ParallelDDPG:
             fns["_state_shardings"] = ss
             # dynamic args of all three entry points, in order: state,
             # buffers, env_states, obs, topo, traffic, start (static
-            # self/num_steps/learn are excluded from in_shardings)
-            arg_sh = (rep, data, data, data, rep, data, rep)
+            # self/num_steps/learn are excluded from in_shardings).  A
+            # per-replica topology carries the [B] replica axis, so it
+            # shards like the other batch data; the historic single-
+            # topology path keeps it replicated.
+            arg_sh = (rep, data, data, data, topo_sh, data, rep)
 
             def shard_jit(method, static, donate_pos, n_in, out_sh):
                 fn = getattr(method, "__wrapped__", method)
@@ -218,7 +222,7 @@ class ParallelDDPG:
             with no_persistent_compile_cache(plan.mesh):
                 out = fn(gather_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
-                         put_once(topo, rep), put_once(traffic, data),
+                         put_once(topo, topo_sh), put_once(traffic, data),
                          jax.device_put(episode_start_step, rep),
                          num_steps, learn)
             return (shard_out(out[0]),) + out[1:]
@@ -230,7 +234,7 @@ class ParallelDDPG:
             with no_persistent_compile_cache(plan.mesh):
                 out = fn(gather_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
-                         put_once(topo, rep), put_once(traffic, data),
+                         put_once(topo, topo_sh), put_once(traffic, data),
                          jax.device_put(episode_start_step, rep),
                          num_steps)
             return (shard_out(out[0]),) + out[1:]
@@ -319,7 +323,11 @@ class ParallelDDPG:
                 jax.random.fold_in(key, 1), next_ob, perm)
             buf = buffer_add(buf, {
                 "obs": ob, "next_obs": next_ob, "action": action,
-                "reward": reward, "done": done.astype(jnp.float32)})
+                "reward": reward, "done": done.astype(jnp.float32),
+                # per-replica network attribution: in mixed-topology
+                # batches tp is this replica's topology slice, so its
+                # topo_id is the mix-entry index
+                "topo_idx": tp.topo_id})
             stats = {"reward": reward, "succ_ratio": info["succ_ratio"],
                      "avg_e2e_delay": info["avg_e2e_delay"]}
             return es, next_ob, next_perm, buf, stats

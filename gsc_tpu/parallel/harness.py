@@ -31,7 +31,8 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                          chunk: int, seed: int,
                          on_episode: Optional[Callable] = None,
                          step_offset: int = 0,
-                         hub=None, timer=None
+                         hub=None, timer=None,
+                         topo_names: Optional[list] = None
                          ) -> Tuple[object, object, list, list, list]:
     """Train for ``episodes`` full episodes; returns (state, buffers,
     per-episode returns, per-episode MEAN success ratios, per-episode
@@ -49,7 +50,14 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
     Trainer.train_parallel) must pass ``ep * episode_steps``, or the
     agent's warmup gate (global_step < nb_steps_warmup_critic selects
     random actions) would restart at 0 every episode and the policy would
-    never act."""
+    never act.
+
+    ``topo_names`` ([B] per-replica topology names, mixed-topology runs):
+    the hub additionally gets per-topology return gauges (tag
+    ``topology=<name>``, mean over that topology's replicas) and the
+    ``harness_episode`` event carries the per-replica ``topology`` list +
+    a ``per_topology_return`` dict — a mixture member that collapses is
+    visible by name, not just as one cold row in the replica vector."""
     from ..obs.trace import phase_span
 
     assert episode_steps % chunk == 0, (episode_steps, chunk)
@@ -99,6 +107,15 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
             if rep_returns is not None:
                 for r, v in enumerate(rep_returns):
                     hub.gauge("replica_return", v, replica=str(r))
+            per_topo = None
+            if rep_returns is not None and topo_names:
+                groups = {}
+                for name, v in zip(topo_names, rep_returns):
+                    groups.setdefault(name, []).append(v)
+                per_topo = {name: float(np.mean(vs))
+                            for name, vs in groups.items()}
+                for name, v in per_topo.items():
+                    hub.gauge("topology_return", v, topology=name)
             if buffers is not None and hasattr(buffers, "size"):
                 for r, fill in enumerate(np.asarray(buffers.size).tolist()):
                     hub.gauge("replica_replay_fill", fill, replica=str(r))
@@ -118,7 +135,13 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                       mean_succ_ratio=succ[-1],
                       final_succ_ratio=final_succ[-1],
                       per_replica_return=rep_returns,
-                      state_finite=finite)
+                      state_finite=finite,
+                      # mixed-topology attribution; absent (not null-
+                      # spammed) on homogeneous runs to keep the legacy
+                      # event schema byte-stable
+                      **({"topology": list(topo_names),
+                          "per_topology_return": per_topo}
+                         if topo_names else {}))
         if on_episode is not None:
             on_episode(ep, returns[-1], succ[-1], metrics)
     return state, buffers, returns, succ, final_succ

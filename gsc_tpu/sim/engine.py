@@ -278,7 +278,15 @@ class SimEngine:
             metrics=state.metrics.reset_run(),
         )
         t_steps = traffic.node_cap.shape[0]
-        cap_now = traffic.node_cap[jnp.clip(state.run_idx, 0, t_steps - 1)]
+        idx_now = jnp.clip(state.run_idx, 0, t_steps - 1)
+        cap_now = traffic.node_cap[idx_now]
+        # link-fault scenarios (topology.scenarios): when the schedule
+        # carries a per-interval edge-capacity table, this interval's row
+        # REPLACES the static edge caps for every substep below — the
+        # structural check is trace-time (None = the historic program,
+        # byte for byte), the row select is device work
+        if traffic.edge_cap_t is not None:
+            topo = topo.replace(edge_cap=traffic.edge_cap_t[idx_now])
 
         def sub(st, _):
             return self._substep(st, topo, traffic, cap_now), None
@@ -321,6 +329,10 @@ class SimEngine:
         t_steps = traffic.node_cap.shape[0]
         idx = jnp.clip(state.run_idx, 0, t_steps - 1)
         cap_now = traffic.node_cap[idx]
+        if traffic.edge_cap_t is not None:
+            # same link-fault row select as apply() — per-flow control
+            # sees the identical capacity timeline
+            topo = topo.replace(edge_cap=traffic.edge_cap_t[idx])
         return self._substep(state, topo, traffic, cap_now,
                              ext_decisions=ext_decisions)
 

@@ -186,6 +186,14 @@ class TrafficSchedule:
     # Per control interval node capacity (traces may raise caps mid-episode,
     # trace_processor.py:44-46); row = topology node_cap when unchanged.
     node_cap: jnp.ndarray     # [T, N] f32
+    # Per control interval EDGE capacity — the link twin of node_cap, used
+    # by mid-episode link-fault scenarios (topology.scenarios): the engine
+    # swaps topo.edge_cap for this table's current row at each interval
+    # start, entirely inside the scanned episode (no host sync).  None
+    # (the default, and every pre-fault producer) keeps the pytree
+    # structure — and therefore every compiled program — byte-identical
+    # to the fault-unaware stack.
+    edge_cap_t: jnp.ndarray = None   # [T, E] f32 or None
 
     @property
     def capacity(self) -> int:

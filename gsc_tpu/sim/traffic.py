@@ -115,8 +115,18 @@ def generate_traffic(
     seed: int,
     trace: Optional[TraceEvents] = None,
     capacity: Optional[int] = None,
+    faults: Sequence = (),
+    with_edge_cap: bool = False,
 ) -> TrafficSchedule:
-    """Sample one episode of traffic into a TrafficSchedule."""
+    """Sample one episode of traffic into a TrafficSchedule.
+
+    ``faults`` (topology.scenarios.TopoFault sequence): deterministic
+    mid-episode capacity faults — node faults zero rows of the
+    per-interval ``node_cap`` table, link faults materialize (and zero
+    rows of) the per-interval ``edge_cap_t`` table the engine
+    row-selects.  ``with_edge_cap`` forces ``edge_cap_t`` even without a
+    link fault, so a mixed batch where only SOME members have link
+    faults still stacks into one consistent pytree structure."""
     rng = np.random.default_rng(seed)
     n = topo.max_nodes
     node_cap = np.asarray(topo.node_cap)
@@ -141,6 +151,12 @@ def generate_traffic(
             if cap is not None:
                 caps[k0:, node] = cap
     active = ~np.isnan(means)
+
+    edge_cap_t = None
+    if faults or with_edge_cap:
+        from ..topology.scenarios import apply_faults
+        caps, edge_cap_t = apply_faults(topo, caps, episode_steps, faults,
+                                        with_edge_cap)
 
     cap_f = capacity if capacity is not None else traffic_capacity(
         cfg, len(ing_idx), episode_steps)
@@ -173,6 +189,7 @@ def generate_traffic(
             arr_egress=jnp.asarray(pad_native(n_egs, -1, np.int32)),
             ingress_active=jnp.asarray(active),
             node_cap=jnp.asarray(caps, np.float32),
+            edge_cap_t=edge_cap_t,
         )
 
     # --- numpy fallback ------------------------------------------------------
@@ -247,4 +264,5 @@ def generate_traffic(
         arr_egress=jnp.asarray(pad_f(egs, -1, np.int32)),
         ingress_active=jnp.asarray(active),
         node_cap=jnp.asarray(caps, np.float32),
+        edge_cap_t=edge_cap_t,
     )

@@ -59,7 +59,8 @@ class DeviceTraffic:
     def __init__(self, cfg: SimConfig, service: ServiceConfig,
                  topo: Topology, episode_steps: int,
                  trace: Optional[TraceEvents] = None,
-                 capacity: Optional[int] = None):
+                 capacity: Optional[int] = None,
+                 faults=(), with_edge_cap: bool = False):
         n = topo.max_nodes
         steps = episode_steps
         node_cap = np.asarray(topo.node_cap)
@@ -80,6 +81,17 @@ class DeviceTraffic:
                     ovr_vals[k0:, node] = np.inf if mean is None else mean
                 if cap is not None:
                     caps[k0:, node] = cap
+        # deterministic capacity-fault scenarios (topology.scenarios):
+        # node faults fold into the per-interval caps table right here —
+        # static per scenario, so episode sampling never re-applies them;
+        # link faults build the [T, E] edge table attached to every
+        # sampled schedule (with_edge_cap forces it so mixed batches
+        # stack structurally even when only some members have one)
+        self.edge_cap_t = None
+        if faults or with_edge_cap:
+            from ..topology.scenarios import apply_faults
+            caps, self.edge_cap_t = apply_faults(topo, caps, steps, faults,
+                                                 with_edge_cap)
         if cfg.use_states:
             active = np.zeros((steps, n), bool)
             active[:, ing_idx] = True
@@ -230,7 +242,8 @@ class DeviceTraffic:
         return TrafficSchedule(
             arr_time=times, arr_ingress=ingress, arr_dr=drs,
             arr_duration=durs, arr_ttl=ttls, arr_sfc=sfcs, arr_egress=egs,
-            ingress_active=self.active, node_cap=self.caps)
+            ingress_active=self.active, node_cap=self.caps,
+            edge_cap_t=self.edge_cap_t)
 
     def sample_batch(self, key, num_replicas: int) -> TrafficSchedule:
         """[B]-stacked schedules (one per replica), a single device call."""

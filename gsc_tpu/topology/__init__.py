@@ -2,17 +2,34 @@ from .compiler import (
     INF_DELAY,
     NetworkSpec,
     Topology,
+    TopologyBucket,
     compile_topology,
     edge_weight,
     geo_delay_ms,
     load_topology,
+    load_topology_cached,
     read_graphml,
     stack_topologies,
 )
 from . import synthetic
+from . import scenarios
+from .scenarios import (
+    DEFAULT_REGISTRY,
+    MixEntry,
+    MixPlan,
+    Scenario,
+    ScenarioRegistry,
+    TopoFault,
+    build_mix_entries,
+    parse_topo_faults,
+    plan_mix,
+)
 
 __all__ = [
-    "INF_DELAY", "NetworkSpec", "Topology", "compile_topology", "edge_weight",
-    "geo_delay_ms", "load_topology", "read_graphml", "stack_topologies",
-    "synthetic",
+    "INF_DELAY", "NetworkSpec", "Topology", "TopologyBucket",
+    "compile_topology", "edge_weight", "geo_delay_ms", "load_topology",
+    "load_topology_cached", "read_graphml", "stack_topologies",
+    "synthetic", "scenarios", "DEFAULT_REGISTRY", "MixEntry", "MixPlan",
+    "Scenario", "ScenarioRegistry", "TopoFault", "build_mix_entries",
+    "parse_topo_faults", "plan_mix",
 ]

@@ -60,6 +60,11 @@ class Topology:
     n_nodes: jnp.ndarray       # [] i32
     n_edges: jnp.ndarray       # [] i32
     diameter: jnp.ndarray      # [] f32 max finite path delay (reader.py:129-133)
+    # position of this topology in its mix/bucket (0 standalone).  Rides the
+    # pytree so a vmapped rollout can stamp each replay transition with the
+    # network it was collected on (mixed-topology batches) without threading
+    # a separate [B] index through every dispatch signature.
+    topo_id: jnp.ndarray       # [] i32
 
     @property
     def max_nodes(self) -> int:
@@ -196,7 +201,7 @@ def _all_pairs(spec: NetworkSpec) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def compile_topology(spec: NetworkSpec, max_nodes: int = 24,
-                     max_edges: int = 37) -> Topology:
+                     max_edges: int = 37, topo_id: int = 0) -> Topology:
     """Pad + tensorize a NetworkSpec into a Topology pytree."""
     n = len(spec.node_caps)
     e = len(spec.edges)
@@ -245,6 +250,7 @@ def compile_topology(spec: NetworkSpec, max_nodes: int = 24,
         next_hop=jnp.asarray(next_hop), path_delay=jnp.asarray(path_delay),
         n_nodes=jnp.asarray(n, jnp.int32), n_edges=jnp.asarray(e, jnp.int32),
         diameter=jnp.asarray(diameter, jnp.float32),
+        topo_id=jnp.asarray(topo_id, jnp.int32),
     )
 
 
@@ -306,3 +312,124 @@ def stack_topologies(topos: Sequence[Topology]) -> Topology:
     import jax
 
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *topos)
+
+
+# Compiled-topology memo shared by every EpisodeDriver in the process: with
+# --runs N (and every schedule re-build) the same GraphML files were parsed
+# and shortest-pathed once per driver construction; the key covers every
+# input that shapes the compiled pytree, plus the file's mtime so an edited
+# asset is never served stale.  Bounded so a long-lived process sweeping
+# many files cannot grow it without limit.
+_LOAD_MEMO: "OrderedDict" = None
+_LOAD_MEMO_MAX = 64
+
+
+def load_topology_cached(path: str, max_nodes: int = 24, max_edges: int = 37,
+                         force_link_cap: Optional[float] = None,
+                         force_node_cap: Optional[Tuple[float, float]] = None,
+                         seed: int = 0, topo_id: int = 0) -> Topology:
+    """Memoized :func:`load_topology` keyed by
+    (abspath, mtime, max_nodes, max_edges, force_link_cap, force_node_cap,
+    seed, topo_id).  The ``topo_id`` stamp is part of the key and applied
+    BEFORE memoization, so the memo returns the SAME Topology object for a
+    repeated key — id()-keyed downstream caches (device placement memos,
+    per-topology traffic samplers) hit across driver rebuilds for every
+    schedule position, not just position 0."""
+    global _LOAD_MEMO
+    import os
+    from collections import OrderedDict
+
+    if _LOAD_MEMO is None:
+        _LOAD_MEMO = OrderedDict()
+    ap = os.path.abspath(path)
+    try:
+        mtime = os.path.getmtime(ap)
+    except OSError:
+        mtime = None   # let load_topology raise its own error
+    key = (ap, mtime, max_nodes, max_edges, force_link_cap,
+           force_node_cap, seed, topo_id)
+    hit = _LOAD_MEMO.get(key)
+    if hit is not None:
+        _LOAD_MEMO.move_to_end(key)
+        return hit
+    topo = load_topology(path, max_nodes=max_nodes, max_edges=max_edges,
+                         force_link_cap=force_link_cap,
+                         force_node_cap=force_node_cap, seed=seed)
+    if topo_id:
+        topo = topo.replace(topo_id=jnp.asarray(topo_id, jnp.int32))
+    _LOAD_MEMO[key] = topo
+    while len(_LOAD_MEMO) > _LOAD_MEMO_MAX:
+        _LOAD_MEMO.popitem(last=False)
+    return topo
+
+
+class TopologyBucket:
+    """Shape bucket: compile K network specs to ONE shared
+    (max_nodes, max_edges) padding and stack the compiled pytrees along a
+    leading axis, so a single vmapped episode runs them side by side.
+
+    Both layers memoize:
+
+    - ``compile(key, spec, topo_id)`` caches the padded pytree per
+      (key, topo_id) — an episode loop that rebuilds its mix every episode
+      never re-pads or re-runs shortest paths;
+    - ``stack(topos)`` caches the stacked tree per tuple of member object
+      ids (the memo retains the member refs, so the ids stay pinned) —
+      the stacked tree handed to the vmapped dispatch is the SAME object
+      every episode, which is what keeps id()-keyed device-placement
+      memos warm and the dispatch retrace-free.
+    """
+
+    def __init__(self, max_nodes: int = 24, max_edges: int = 37):
+        self.max_nodes = max_nodes
+        self.max_edges = max_edges
+        self._compiled = {}   # (key, topo_id) -> Topology
+        self._stacked = {}    # tuple(id(t)) -> (members, stacked)
+
+    def compile(self, key, spec: NetworkSpec, topo_id: int = 0) -> Topology:
+        """Compile ``spec`` into this bucket's padding (memoized per
+        (key, topo_id)); raises ValueError when the spec exceeds the
+        bucket, naming the bucket dims."""
+        memo_key = (key, topo_id)
+        hit = self._compiled.get(memo_key)
+        if hit is not None:
+            return hit
+        try:
+            topo = compile_topology(spec, max_nodes=self.max_nodes,
+                                    max_edges=self.max_edges,
+                                    topo_id=topo_id)
+        except ValueError as e:
+            raise ValueError(
+                f"topology {key!r} does not fit bucket "
+                f"[{self.max_nodes} nodes, {self.max_edges} edges]: {e}")
+        self._compiled[memo_key] = topo
+        return topo
+
+    def adopt(self, key, topo: Topology, topo_id: int = 0) -> Topology:
+        """Register an ALREADY-compiled topology (e.g. a schedule network
+        the driver loaded) under this bucket, re-stamped with ``topo_id``.
+        Validates the padding matches the bucket — mixing shapes would
+        fail deep inside vmap with an opaque stacking error."""
+        if (topo.max_nodes, topo.max_edges) != (self.max_nodes,
+                                                self.max_edges):
+            raise ValueError(
+                f"topology {key!r} is padded to [{topo.max_nodes}, "
+                f"{topo.max_edges}], bucket is [{self.max_nodes}, "
+                f"{self.max_edges}]")
+        memo_key = (key, topo_id)
+        hit = self._compiled.get(memo_key)
+        if hit is not None:
+            return hit
+        stamped = topo.replace(topo_id=jnp.asarray(topo_id, jnp.int32))
+        self._compiled[memo_key] = stamped
+        return stamped
+
+    def stack(self, topos: Sequence[Topology]) -> Topology:
+        """Memoized :func:`stack_topologies` over bucket members."""
+        key = tuple(id(t) for t in topos)
+        hit = self._stacked.get(key)
+        if hit is not None:
+            return hit[1]
+        stacked = stack_topologies(list(topos))
+        self._stacked[key] = (tuple(topos), stacked)
+        return stacked

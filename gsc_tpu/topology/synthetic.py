@@ -386,6 +386,30 @@ def line(n: int = 3, node_cap: float = 10.0, link_cap: float = 100.0,
     return NetworkSpec(node_caps=[node_cap] * n, node_types=types, edges=edges)
 
 
+def star(n: int = 6, node_cap: float = 10.0, link_cap: float = 100.0,
+         link_delay: float = 1.0, num_ingress: int = 1) -> NetworkSpec:
+    """Hub-and-spoke: node 0 is the hub, nodes 1..n-1 hang off it — the
+    maximal-contention shape (every path crosses the hub)."""
+    if n < 2:
+        raise ValueError(f"star needs >= 2 nodes, got {n}")
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = [(0, i, link_cap, link_delay) for i in range(1, n)]
+    return NetworkSpec(node_caps=[node_cap] * n, node_types=types,
+                       edges=edges)
+
+
+def ring(n: int = 6, node_cap: float = 10.0, link_cap: float = 100.0,
+         link_delay: float = 1.0, num_ingress: int = 1) -> NetworkSpec:
+    """Cycle of n nodes — two disjoint paths between any pair, the
+    smallest shape where routing has a real choice."""
+    if n < 3:
+        raise ValueError(f"ring needs >= 3 nodes, got {n}")
+    types = ["Ingress" if i < num_ingress else "Normal" for i in range(n)]
+    edges = [(i, (i + 1) % n, link_cap, link_delay) for i in range(n)]
+    return NetworkSpec(node_caps=[node_cap] * n, node_types=types,
+                       edges=edges)
+
+
 def two_node(node_caps: Sequence[float] = (5.0, 5.0), link_cap: float = 100.0,
              link_delay: float = 1.0) -> NetworkSpec:
     return NetworkSpec(node_caps=list(node_caps),

@@ -107,7 +107,8 @@ def test_gradient_step_changes_params_and_targets_slowly():
     action = jnp.ones(env.limits.action_dim) * 0.5
     buf = buffer_add(buf, {"obs": obs, "next_obs": obs, "action": action,
                            "reward": jnp.asarray(1.0),
-                           "done": jnp.asarray(0.0)})
+                           "done": jnp.asarray(0.0),
+                           "topo_idx": jnp.asarray(0, jnp.int32)})
     new_state, metrics = ddpg.gradient_step(state, buf, jax.random.PRNGKey(3))
     # online params moved
     diff = jax.tree_util.tree_map(

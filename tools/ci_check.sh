@@ -45,6 +45,13 @@ echo "== multihost smoke (pjit carving bit-equality: replicated vs sharded) =="
 env JAX_PLATFORMS=cpu python tools/dryrun_multihost.py --mesh-matrix \
     --legs "8x1:replicated,4x2:sharded" --leg-timeout 420
 
+echo "== mixtopo smoke (mixed-topology batch: 2 networks, one dispatch) =="
+# a tiny 2-episode train run with --topo-mix "schedule,line3" must exit 0
+# with per-topology return gauges in metrics.json and per_topology_return
+# on every harness_episode event (tools/mixtopo_smoke.py asserts both
+# plus the run_end status and the run_start topo_mix tag)
+env JAX_PLATFORMS=cpu python tools/mixtopo_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

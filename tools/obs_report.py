@@ -222,6 +222,26 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         mesh = {"mesh": run_start["mesh"],
                 "partition_rules": run_start.get("partition_rules"),
                 "partition_specs": run_start.get("partition_specs") or {}}
+    # mixed-topology section (cli train --topo-mix): harness_episode
+    # events carry per-topology mean returns when the batch is a mixture
+    # — aggregated here per network name so a collapsing mixture member
+    # is readable off the report, not buried in replica vectors
+    topo_mix = (run_start or {}).get("topo_mix")
+    per_topology = {}
+    for ev in events:
+        if ev.get("event") != "harness_episode":
+            continue
+        for name, v in (ev.get("per_topology_return") or {}).items():
+            rec = per_topology.setdefault(
+                name, {"episodes": 0, "sum": 0.0, "last": None})
+            rec["episodes"] += 1
+            rec["sum"] += float(v)
+            rec["last"] = float(v)
+    per_topology = {
+        name: {"episodes": r["episodes"],
+               "mean_return": round(r["sum"] / max(r["episodes"], 1), 3),
+               "last_return": round(r["last"], 3)}
+        for name, r in per_topology.items()}
     # serving section (cli serve runs): the final serve_stats event holds
     # the cumulative numbers; serve_start carries startup + cache hits
     serve_start = next((e for e in events
@@ -253,6 +273,8 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "precision": precision,
         "engine": engine,
         "mesh": mesh,
+        "topo_mix": topo_mix,
+        "per_topology": per_topology,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -317,6 +339,8 @@ def render_text(summary: Dict, out=sys.stdout):
         w(f"mesh: {mesh.get('mesh')}  rules: "
           f"{mesh.get('partition_rules')}"
           + (f"  ({spec_txt})" if spec_txt else "") + "\n")
+    if summary.get("topo_mix"):
+        w(f"topo mix: {summary['topo_mix']}\n")
     if summary.get("runs_in_stream", 1) > 1:
         w(f"(stream holds {summary['runs_in_stream']} appended runs — "
           "showing the last)\n")
@@ -347,6 +371,14 @@ def render_text(summary: Dict, out=sys.stdout):
         w("  ".join(c.rjust(widths[c]) for c in cols) + "\n")
         for r in rows:
             w("  ".join(_fmt(r.get(c), widths[c]) for c in cols) + "\n")
+    if summary.get("per_topology"):
+        w("\nper-topology returns (mixed batch, mean over the topology's "
+          "replicas):\n")
+        w(f"  {'topology':<28} {'episodes':>8} {'mean_return':>12} "
+          f"{'last_return':>12}\n")
+        for name, rec in sorted(summary["per_topology"].items()):
+            w(f"  {name:<28} {rec['episodes']:>8} "
+              f"{rec['mean_return']:>12} {rec['last_return']:>12}\n")
     w("\nper-phase host wall (cumulative):\n")
     for name, info in summary["phase_summary"].items():
         w(f"  {name:<18} total {info['total_s']:>9}s   "
@@ -423,8 +455,21 @@ def _synthetic_events(path: str, episodes: int = 5):
               "episodes": episodes, "precision": "bf16",
               "substep_impl": "pallas", "unroll": 2,
               "mesh": "4x2", "partition_rules": "sharded",
+              "topo_mix": "schedule,abilene+bursty",
               "partition_specs": {"PartitionSpec()": 87,
                                   "PartitionSpec(None, 'mp')": 44}})
+        # mixed-topology harness events: per-replica topology names +
+        # per-topology mean returns ride each episode's harness record
+        for ep in range(2):
+            emit({"event": "harness_episode", "ts": base + ep,
+                  "run": "selftest", "episode": ep,
+                  "episodic_return": 1.0 + ep, "mean_succ_ratio": 0.5,
+                  "final_succ_ratio": 0.5,
+                  "per_replica_return": [2.0 + ep, 0.0 + ep],
+                  "topology": ["abilene.graphml", "abilene+bursty"],
+                  "per_topology_return": {"abilene.graphml": 2.0 + ep,
+                                          "abilene+bursty": 0.0 + ep},
+                  "state_finite": True})
         # the dtype-gauge event the trainer emits via record_precision
         emit({"event": "precision", "ts": base, "run": "selftest",
               "name": "bf16", "param_dtype": "float32",
@@ -530,11 +575,24 @@ def selftest() -> int:
             "partition_specs": {"PartitionSpec()": 87,
                                 "PartitionSpec(None, 'mp')": 44}}, \
             "mesh header not surfaced"
+        assert summary["topo_mix"] == "schedule,abilene+bursty", \
+            "topo_mix header not surfaced"
+        assert summary["per_topology"] == {
+            "abilene.graphml": {"episodes": 2, "mean_return": 2.5,
+                                "last_return": 3.0},
+            "abilene+bursty": {"episodes": 2, "mean_return": 0.5,
+                               "last_return": 1.0}}, \
+            "per-topology returns not aggregated"
         import io
         txt = io.StringIO()
         render_text(summary, out=txt)
         assert "mesh: 4x2  rules: sharded" in txt.getvalue(), \
             "mesh header line not rendered"
+        assert "topo mix: schedule,abilene+bursty" in txt.getvalue(), \
+            "topo-mix header line not rendered"
+        assert "per-topology returns" in txt.getvalue() \
+            and "abilene+bursty" in txt.getvalue(), \
+            "per-topology table not rendered"
         assert len(summary["stalls"]) == 1, "stall not surfaced"
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1
