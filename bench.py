@@ -664,6 +664,45 @@ def worker(replicas: int, chunk: int, episodes: int,
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
 
+    # opt-in device-cost ledger (--perf / GSC_BENCH_PERF=1): compile-time
+    # FLOPs / bytes / fusion counts of the measured dispatch kernel ride
+    # every banked row, so tools/bench_diff.py can diff op-count structure
+    # across rounds without a separate profiling run.  Off by default —
+    # the capture is one extra AOT trace before warmup, and the official
+    # chip artifact must measure exactly the historic startup sequence.
+    cost_entry = None
+    if _env_int("GSC_BENCH_PERF", 0):
+        from gsc_tpu.obs.perf import CostLedger, resolve_lowerable
+        ledger = CostLedger()
+        cost_name = "chunk_step" if pipeline else "rollout_episodes"
+        # the dispatched-executable resolver shared with the Trainer:
+        # the donated instance partial when present (its backend compile
+        # seeds the persistent cache the warmup then hits), else the
+        # unsharded class jit (the sharded-plan wrappers are plain
+        # closures with no .lower)
+        cost_fn, cost_pre = resolve_lowerable(pddpg, cost_name)
+        cost_args = (*cost_pre, state, buffers, env_states, obs, topo,
+                     traffic, jnp.int32(0))
+        cost_kw = ({"num_steps": chunk, "learn": True} if pipeline
+                   else {"num_steps": chunk})
+        # banked jit_traces stay comparable to non---perf rounds.
+        # Meshless: the AOT lower and the first dispatch SHARE the pjit
+        # trace cache (measured), so capture+dispatch trace the
+        # learn=True variant exactly once either way — do NOT pause the
+        # monitor (that would LOSE the one count).  Under a mesh the
+        # sharded dispatch jits a separate copy of the function, so the
+        # class-jit capture WOULD add a spurious +1 under the same name
+        # — pause the monitor for exactly that case.
+        if plan is not None:
+            monitor.stop()
+            try:
+                ledger.capture(cost_name, cost_fn, cost_args, cost_kw)
+            finally:
+                monitor.start()
+        else:
+            ledger.capture(cost_name, cost_fn, cost_args, cost_kw)
+        cost_entry = {cost_name: ledger.entry(cost_name)}
+
     from gsc_tpu.obs.device import device_memory_snapshot
     from gsc_tpu.utils.telemetry import PhaseTimer
     timer = PhaseTimer()
@@ -732,6 +771,7 @@ def worker(replicas: int, chunk: int, episodes: int,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),
             "device_mem": [m for m in mem if m.get("available")],
+            **({"cost": cost_entry} if cost_entry else {}),
             **({"knobs": knobs} if knobs else {}),
         }), flush=True)
 
@@ -843,6 +883,13 @@ if __name__ == "__main__":
                              f"replicated|sharded, got {rules!r}")
         os.environ["GSC_BENCH_PARTITION_RULES"] = rules
         del argv[i:i + 2]
+    if "--perf" in argv:
+        # boolean knob (no value): forwarded to worker subprocesses via
+        # the environment like the others — every rung then banks its
+        # dispatch kernel's compile-time cost next to the rate
+        i = argv.index("--perf")
+        os.environ["GSC_BENCH_PERF"] = "1"
+        del argv[i:i + 1]
     if "--topo-mix" in argv:
         # forwarded via the environment like --precision; a missing value
         # must ERROR — a silently-homogeneous row would mislabel a run

@@ -262,6 +262,46 @@ class Trainer:
             self.obs.recovery(episode=episode, site=site, action=action,
                               fault=fault, attempt=attempt, detail=detail)
 
+    # -------------------------------------------------------- cost ledger
+    @staticmethod
+    def _ledger_fn(owner, name: str):
+        """The dispatched-executable resolver (obs.perf.resolve_lowerable)
+        — kept as a method so both train paths read the same way."""
+        from ..obs.perf import resolve_lowerable
+        return resolve_lowerable(owner, name)
+
+    def _capture_costs(self, names_args: Dict[str, tuple]):
+        """Feed the observer's device-cost ledger (obs.perf.CostLedger):
+        AOT-lower each watched entry point ONCE, before the episode loop,
+        so FLOPs/bytes/fusion counts are captured at compile time and the
+        dispatch path itself stays sync-free.  ``names_args`` maps entry
+        name -> (fn, args, kwargs); lowering never executes the program,
+        so passing the live (donation-bound) carries is safe.  Best
+        effort: a cost-model failure is a warning, never a dead run."""
+        perf = getattr(self.obs, "perf", None) if self.obs else None
+        if perf is None:
+            return
+        for name, (fn, args, kwargs) in names_args.items():
+            perf.capture(name, fn, args, kwargs)
+
+    def _note_cost_timings(self, timer, primary: Optional[str]):
+        """Merge the run's measured host wall into the ledger AFTER the
+        loop: the ``dispatch`` phase total attributes to the primary
+        FUSED entry point (its calls are exactly what the phase wraps),
+        and the full phase summary rides along as the device-vs-host
+        split.  ``primary=None`` on the serial two-call path — there the
+        dispatch phase covers rollout AND learn burst, and splitting it
+        per entry would fabricate MFU numbers, so serial runs keep
+        static costs + phases only."""
+        perf = getattr(self.obs, "perf", None) if self.obs else None
+        if perf is None or timer is None:
+            return
+        phases = timer.summary()
+        disp = phases.get("dispatch")
+        if primary is not None and disp:
+            perf.note_timing(primary, disp["total_s"], disp["count"])
+        perf.note_phases(phases)
+
     def _prefetch_fault_hook(self):
         """``before_episode`` hook for the prefetcher's producer thread —
         the injection point of the two producer-side fault sites."""
@@ -526,6 +566,28 @@ class Trainer:
                     if self.ddpg.donate else
                     " — copied each episode (donate=False)")
 
+            # device-cost ledger capture (obs.perf): AOT-lower the watched
+            # entry points ONCE, here at compile time — before any dispatch
+            # and before donation can consume a carry (lowering never
+            # executes the program; see _ledger_fn for which executable is
+            # mined).  The steady-state variant (learn=True) is the one
+            # the roofline table describes.
+            gs0 = np.int32(start_episode * steps_per_ep)
+            if pipeline:
+                fn, pre = self._ledger_fn(self.ddpg, "episode_step")
+                self._capture_costs({"episode_step": (
+                    fn, (*pre, state, buffer, env_state, obs, topo,
+                         traffic, gs0), {"learn": True})})
+            else:
+                r_fn, r_pre = self._ledger_fn(self.ddpg, "rollout_episode")
+                l_fn, l_pre = self._ledger_fn(self.ddpg, "learn_burst")
+                self._capture_costs({
+                    "rollout_episode": (
+                        r_fn, (*r_pre, state, buffer, env_state, obs,
+                               topo, traffic, gs0), {}),
+                    "learn_burst": (l_fn, (*l_pre, state, buffer), {}),
+                })
+
             if guard is not None:
                 # rollback target for a violation before any episode has
                 # been verified (the fresh/restored state is finite)
@@ -662,6 +724,11 @@ class Trainer:
             if prefetch is not None:
                 prefetch.close()
         self.completed_episodes = self._last_drained + 1
+        # measured wall -> ledger AFTER the loop (the deferred-drain
+        # totals), so MFU/roofline derive from timings the dispatch path
+        # already paid for — zero new host syncs
+        self._note_cost_timings(
+            timer, "episode_step" if pipeline else None)
         if plan is not None and plan.unfired():
             # a mis-keyed plan (episode index past the run's end, a site
             # the run shape never reaches) must be loud: a chaos test
@@ -850,6 +917,46 @@ class Trainer:
                 topo = (mix_plan.topo if mix_plan is not None
                         else self.driver.topology_for(ep))
                 traffic = episode_traffic(ep, topo)
+                if ep == start_episode and self.obs is not None \
+                        and getattr(self.obs, "perf", None) is not None:
+                    # cost-ledger capture for the replica path: shapes-only
+                    # reset via eval_shape (no device work), then AOT-lower
+                    # the fused chunk kernel's steady-state variant.  Under
+                    # a sharding plan this lowers the PLAIN jit — the
+                    # per-call cost of the unsharded program, which is the
+                    # comparable number across mesh carvings — and because
+                    # the sharded dispatch jits its own copy, that capture
+                    # trace would read as a spurious chunk_step retrace in
+                    # the sentinel stream: pause the monitor for exactly
+                    # that case (meshless captures share the dispatch's
+                    # trace cache, so they stay un-paused and count once,
+                    # same reasoning as bench.py's --perf path).
+                    mon = self.obs.compile_monitor
+                    paused = plan is not None and mon is not None
+                    if paused:
+                        mon.stop()
+                    try:
+                        pcls = type(pddpg)
+                        es_s, obs_s = pcls.reset_all.eval_shape(
+                            pddpg, jax.random.PRNGKey(0), topo, traffic)
+                        c_fn, c_pre = self._ledger_fn(pddpg, "chunk_step")
+                        l_fn, l_pre = self._ledger_fn(pddpg, "learn_burst")
+                        self._capture_costs({
+                            "chunk_step": (
+                                c_fn,
+                                (*c_pre, state, buffers, es_s, obs_s,
+                                 topo, traffic,
+                                 np.int32(ep * steps_per_ep)),
+                                {"num_steps": chunk, "learn": True}),
+                            "learn_burst": (
+                                l_fn, (*l_pre, state, buffers), {}),
+                        })
+                    except Exception as e:  # noqa: BLE001 - never fatal
+                        log.warning("cost-ledger capture skipped on the "
+                                    "replica path: %s", e)
+                    finally:
+                        if paused:
+                            mon.start()
                 if self.obs:
                     self.obs.episode_dispatched(ep)
                 state, buffers, rets, succ, final = run_chunked_episodes(
@@ -911,6 +1018,7 @@ class Trainer:
             if self.obs:
                 self.obs.pause_watchdog()
         self.completed_episodes = self._last_drained + 1
+        self._note_cost_timings(timer, "chunk_step")
         self.rewards_writer.close()
         if self.tb:
             self.tb.close()

@@ -21,7 +21,7 @@ argument is an already-compiled jax ``Compiled`` object (or its
 """
 from __future__ import annotations
 
-__all__ = ["count_fusions", "count_ops", "hlo_text"]
+__all__ = ["count_fusions", "count_ops", "hlo_text", "op_histogram"]
 
 
 def hlo_text(compiled_or_text) -> str:
@@ -51,3 +51,10 @@ def count_ops(compiled_or_text, op: str) -> int:
     companion to :func:`count_fusions` (a CPU scatter lowers to a serial
     ``while``, a fact the megakernel work keeps re-learning)."""
     return hlo_text(compiled_or_text).count(f" {op}(")
+
+
+def op_histogram(compiled_or_text, ops) -> dict:
+    """``{op: count}`` over a list of HLO op names, one text pass per op —
+    the batch form the cost ledger (obs.perf) stores per entry point."""
+    text = hlo_text(compiled_or_text)
+    return {op: text.count(f" {op}(") for op in ops}

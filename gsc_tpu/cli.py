@@ -330,6 +330,21 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--obs-interval", default=10, show_default=True,
               help="episodes between atomic metrics.json snapshot "
                    "rewrites")
+@click.option("--obs-rotate-mb", default=0.0, show_default=True,
+              help="size-based events.jsonl rotation for long exhibits: "
+                   "when the live stream exceeds this many MiB it rotates "
+                   "to events.jsonl.1..N (readers — obs_report, the trace "
+                   "exporter — walk the segments transparently; 0 = no "
+                   "rotation)")
+@click.option("--perf/--no-perf", "perf_enabled", default=True,
+              show_default=True,
+              help="device-cost ledger: capture compiled FLOPs/bytes/"
+                   "fusion counts of the watched entry points at compile "
+                   "time, merge the run's phase wall into per-dispatch "
+                   "MFU/roofline, and write perf.json next to "
+                   "metrics.json (tools/bench_diff.py diffs them across "
+                   "runs).  Costs one extra AOT trace per entry point at "
+                   "startup; adds nothing to the dispatch path")
 @click.option("--watchdog-budget", default=300.0, show_default=True,
               help="seconds without a completed episode before the "
                    "pipeline watchdog emits a structured 'stall' event "
@@ -372,8 +387,9 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           profile, runs, resume, resource_functions_path, replicas, chunk,
           mesh, partition_rules, topo_mix, pipeline, precision,
           substep_impl, unroll, obs_enabled, obs_dir, obs_interval,
-          watchdog_budget, watchdog_escalate, check_invariants, fault_plan,
-          rollback, ckpt_interval, ckpt_retain, jax_cache_dir, verbose):
+          obs_rotate_mb, perf_enabled, watchdog_budget, watchdog_escalate,
+          check_invariants, fault_plan, rollback, ckpt_interval,
+          ckpt_retain, jax_cache_dir, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -553,6 +569,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             obs = RunObserver(odir, snapshot_interval=obs_interval,
                               watchdog_budget_s=watchdog_budget,
                               watchdog_escalate=watchdog_escalate,
+                              rotate_mb=obs_rotate_mb, perf=perf_enabled,
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
@@ -801,12 +818,18 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
 @click.option("--obs-dir", default=None,
               help="directory for events.jsonl/metrics.json "
                    "(default: the run's result dir)")
+@click.option("--perf/--no-perf", "perf_enabled", default=True,
+              show_default=True,
+              help="device-cost ledger over the serving buckets: each "
+                   "serve_policy_b<B> records compiled FLOPs/bytes/"
+                   "fusions at start() and its measured latency merges "
+                   "in at close() — perf.json lands next to metrics.json")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 def serve(agent_config, simulator_config, service, scheduler, checkpoint,
           requests, concurrency, buckets, deadline_ms, artifact_cache,
           pool_steps, stats_interval, request_timeout, seed, max_nodes,
           max_edges, resource_functions_path, result_dir, obs_enabled,
-          obs_dir, jax_cache_dir):
+          obs_dir, perf_enabled, jax_cache_dir):
     """Serve coordination decisions from an AOT-compiled greedy policy.
 
     With CHECKPOINT: restores the actor, ahead-of-time compiles the
@@ -876,7 +899,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     obs_rec = None
     if obs_enabled:
         from .obs import RunObserver
-        obs_rec = RunObserver(obs_dir or rdir, tags={"seed": seed})
+        obs_rec = RunObserver(obs_dir or rdir, tags={"seed": seed},
+                              perf=perf_enabled)
         obs_rec.start(meta={
             "mode": "serve", "tier": tier, "seed": seed,
             "requests": requests, "concurrency": concurrency,
@@ -915,7 +939,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
                 precision=agent.precision,
                 substep_impl=env.sim_cfg.substep_impl,
                 graph_mode=agent.graph_mode, hub=hub,
-                stats_interval=stats_interval)
+                stats_interval=stats_interval,
+                perf=(obs_rec.perf if obs_rec is not None else None))
         else:
             server = PolicyServer(
                 fallback=SPRFallbackPolicy(topo, env.limits, obs0),

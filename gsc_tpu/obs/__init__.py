@@ -26,6 +26,11 @@ pipelines honest; this package is that substrate:
   finishes within a wall budget.
 - :mod:`~gsc_tpu.obs.trace` — ``jax.profiler`` annotations so ``--profile``
   traces attribute device time to pipeline phases.
+- :class:`CostLedger` (:mod:`~gsc_tpu.obs.perf`) — compile-time
+  FLOPs/bytes/fusion counts per watched entry point merged with the
+  drained wall timings into per-dispatch MFU and roofline position;
+  serialized as the schema-versioned per-run ``perf.json``
+  (``tools/bench_diff.py`` diffs them across runs).
 - :class:`RunObserver` — the facade the trainer/CLI wire through.  It
   also owns a per-run retrace sentinel
   (:class:`gsc_tpu.analysis.sentinels.CompileMonitor`): jit traces / XLA
@@ -36,12 +41,14 @@ All later perf PRs report through this subsystem.
 """
 from .device import device_memory_snapshot, record_device_gauges
 from .hub import MetricsHub
+from .perf import PERF_SCHEMA_VERSION, CostLedger
 from .run import RunObserver
-from .sinks import JsonlSink, ListSink, write_atomic_json
+from .sinks import JsonlSink, ListSink, rotated_paths, write_atomic_json
 from .watchdog import PipelineWatchdog
 
 __all__ = [
     "MetricsHub", "JsonlSink", "ListSink", "write_atomic_json",
-    "device_memory_snapshot", "record_device_gauges", "PipelineWatchdog",
-    "RunObserver",
+    "rotated_paths", "device_memory_snapshot", "record_device_gauges",
+    "PipelineWatchdog", "RunObserver", "CostLedger",
+    "PERF_SCHEMA_VERSION",
 ]

@@ -17,9 +17,14 @@ _KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
 
 def device_memory_snapshot() -> List[Dict]:
-    """One record per local device: ``{"device", "available", and (when the
-    backend exposes allocator stats) bytes_in_use/peak_bytes_in_use/
-    bytes_limit}``."""
+    """One record per local device: ``{"device", "available", "backend",
+    and (when the backend exposes allocator stats) bytes_in_use/
+    peak_bytes_in_use/bytes_limit}``.
+
+    ``available: false`` records carry the backend name (``memory_stats()``
+    is ``None`` on CPU) so downstream readers — obs_report's memory
+    section, the bench rows — can distinguish "this backend has no HBM
+    data" from "usage was flat" instead of silently skipping the device."""
     import jax
 
     records = []
@@ -28,7 +33,8 @@ def device_memory_snapshot() -> List[Dict]:
             stats = d.memory_stats()
         except Exception:   # backends without the API raise rather than
             stats = None    # return None (older plugin versions)
-        rec: Dict = {"device": str(d), "available": bool(stats)}
+        rec: Dict = {"device": str(d), "available": bool(stats),
+                     "backend": getattr(d, "platform", "unknown")}
         if stats:
             for k in _KEYS:
                 if k in stats:

@@ -29,14 +29,28 @@ class RunObserver:
                  watchdog_budget_s: float = 0.0,
                  tags: Optional[Dict[str, object]] = None,
                  compile_events: bool = True,
-                 watchdog_escalate: int = 0):
+                 watchdog_escalate: int = 0,
+                 rotate_mb: float = 0.0,
+                 perf: bool = False):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
         self.hub = MetricsHub(tags={"run": run_id, **(tags or {})})
         self.events_path = os.path.join(self.out_dir, "events.jsonl")
         self.snapshot_path = os.path.join(self.out_dir, "metrics.json")
-        self.hub.add_sink(JsonlSink(self.events_path))
+        self.perf_path = os.path.join(self.out_dir, "perf.json")
+        # size-based rotation for 100+-episode exhibits (``--obs-rotate-mb``)
+        # — readers walk the rotated segments via sinks.rotated_paths
+        self.hub.add_sink(JsonlSink(self.events_path, rotate_mb=rotate_mb))
+        # device-cost ledger (obs.perf.CostLedger): opt-in because each
+        # captured entry point costs one extra AOT trace at setup time —
+        # the CLI enables it by default (--perf), bare test observers
+        # don't pay for it.  The trainer/server capture into it; close()
+        # writes perf.json next to metrics.json.
+        self.perf = None
+        if perf:
+            from .perf import CostLedger
+            self.perf = CostLedger(hub=self.hub)
         self.snapshot_interval = max(int(snapshot_interval), 1)
         self.watchdog: Optional[PipelineWatchdog] = None
         if watchdog_budget_s and watchdog_budget_s > 0:
@@ -87,6 +101,14 @@ class RunObserver:
         if self.compile_monitor is not None:
             self.compile_monitor.stop()
         try:
+            if self.perf is not None and self.perf.summary()["entries"]:
+                # the per-run cost ledger lands next to metrics.json —
+                # best effort, a cost-model failure must not mask the
+                # run's own teardown
+                try:
+                    self.perf.write_json(self.perf_path)
+                except Exception:
+                    pass
             self.hub.event("run_end", status=status,
                            episodes=self._drained,
                            stalls=self.hub.get_counter("stalls"),

@@ -1,21 +1,41 @@
-"""Profiler trace annotations for the episode pipeline.
+"""Profiler annotations + the events.jsonl -> Perfetto trace exporter.
 
-A ``--profile`` trace of the pipelined trainer used to be one opaque blob:
-the fused rollout+learn program, the prefetch waits and the metric drains
-all interleave with nothing attributing device time to pipeline phases.
-These helpers wrap the host-side phases in ``jax.profiler.TraceAnnotation``
-(named ranges on the host timeline that the trace viewer correlates with
-the device stream) and each episode dispatch in
-``jax.profiler.StepTraceAnnotation`` (the step marker TensorBoard's
-profiler uses for per-step device attribution).
+Two halves, one module (both are "how a run becomes a timeline"):
 
+**Live annotations** — ``--profile`` traces of the pipelined trainer used
+to be one opaque blob: the fused rollout+learn program, the prefetch
+waits and the metric drains all interleave with nothing attributing
+device time to pipeline phases.  :func:`phase_span` wraps the host-side
+phases in ``jax.profiler.TraceAnnotation`` and :func:`episode_span` marks
+each episode dispatch with ``jax.profiler.StepTraceAnnotation``.
 Annotation names are stable API — tooling and docs reference them:
 ``host_sample``, ``host_sample_wait``, ``dispatch``, ``drain`` (phase
 ranges) and ``episode_step`` (the per-episode step marker).
+
+**Post-hoc export** — a run's ``events.jsonl`` already carries everything
+a timeline needs (episode boundaries, cumulative PhaseTimer totals,
+stalls, recovery ladders, compile events, serve stats), but reading a
+stall out of log-line timestamp deltas is archaeology.
+:func:`build_trace` renders the stream into Chrome trace-event JSON
+(the format Perfetto / ``chrome://tracing`` open directly): one track
+per logical thread — episode loop, prefetcher, serve, watchdog, compile
+— with watchdog stalls as instant events and recovery/rollback ladders
+chained by flow arrows.  Phase sub-spans are RECONSTRUCTED from the
+cumulative per-episode deltas (laid back-to-back inside each episode's
+span and clamped to it), so they show relative share faithfully but not
+exact start times.  :func:`validate_trace` is the strict schema check
+(monotone ts per track, matched B/E pairs, pid/tid present) that CI and
+the exporter gate on; ``tools/trace_export.py`` is the CLI.
+
+The export half is deliberately jax-free (stdlib + the sibling sinks
+reader) — it must run anywhere the events stream can be copied to.
 """
 from __future__ import annotations
 
+import json
+import os
 from contextlib import contextmanager
+from typing import Dict, List, Optional
 
 
 @contextmanager
@@ -47,3 +67,299 @@ def episode_span(step: int, name: str = "episode_step"):
 
     with jax.profiler.StepTraceAnnotation(name, step_num=int(step)):
         yield
+
+
+# --------------------------------------------------------------- exporter
+# one pid per run stream; fixed tids = the logical threads of a run.
+# Stable API: tools and tests reference these names.
+TRACE_PID = 1
+TRACE_TRACKS = {
+    "episode": 1,      # training loop: episode spans + phase sub-spans
+    "prefetcher": 2,   # producer-thread restarts
+    "serve": 3,        # serve_start / serve_stats counters
+    "watchdog": 4,     # stalls, escalations, invariant violations
+    "compile": 5,      # jit trace/XLA compile spans + compile_cost marks
+    "recovery": 6,     # self-healing ladder, chained by flow arrows
+}
+# phase sub-span layout order inside an episode slice (the obs schema's
+# cumulative PhaseTimer names)
+_TRACE_PHASES = ("host_sample", "host_sample_wait", "dispatch", "drain")
+
+
+def read_events(path: str) -> List[Dict]:
+    """Load a run's event stream: accepts the run dir or the events.jsonl
+    itself, walks rotated segments (``events.jsonl.N .. .1`` then the
+    live file — the ``--obs-rotate-mb`` layout), skips torn tail lines."""
+    from .sinks import rotated_paths
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    segments = [p for p in rotated_paths(path) if os.path.exists(p)]
+    if not segments:
+        raise FileNotFoundError(f"no events stream at {path}")
+    events = []
+    for seg in segments:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue   # torn final line of a live segment
+    return events
+
+
+def _us(ts: float, t0: float) -> float:
+    return round((ts - t0) * 1e6, 1)
+
+
+def build_trace(events: List[Dict]) -> Dict:
+    """Chrome trace-event JSON from an obs event stream.
+
+    Episode slices sit back-to-back on the episode track (each ends at
+    its event's wall ts); phase sub-spans are reconstructed from the
+    per-episode deltas of the cumulative PhaseTimer totals, laid
+    sequentially inside the episode slice and scaled down if they would
+    overflow it — faithful shares, synthetic start times.  Stalls /
+    escalations / invariant violations are instants on the watchdog
+    track; consecutive ``recovery`` events chain with flow arrows so a
+    retry -> restart -> rollback ladder reads as one connected story."""
+    events = [e for e in events if isinstance(e, dict) and "ts" in e]
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    # hub.event stamps ts before the sink lock, so concurrently-emitting
+    # threads (watchdog, prefetcher, main loop) can land out of order in
+    # the file; process in timestamp order or a recovery ladder's flow
+    # arrow could point backwards and fail the strict validator.  Stable
+    # sort: same-ts events keep file order.
+    events = sorted(events, key=lambda e: float(e["ts"]))
+    t0 = float(events[0]["ts"])
+    run = next((e.get("run") for e in events if e.get("run")), "run")
+    out: List[Dict] = []
+
+    # named `push`, not `emit`: a device-side scan body already owns
+    # that name, and gsc-lint's name-graph would treat this host-only
+    # helper as traced
+    def push(ph, name, tid, ts_us, dur=None, args=None, **extra):
+        ev = {"ph": ph, "name": name, "pid": TRACE_PID, "tid": tid,
+              "ts": ts_us, "cat": "gsc"}
+        if dur is not None:
+            ev["dur"] = dur
+        if args:
+            ev["args"] = args
+        ev.update(extra)
+        out.append(ev)
+
+    # track metadata (ph "M"): process + thread names
+    out.append({"ph": "M", "name": "process_name", "pid": TRACE_PID,
+                "tid": 0, "ts": 0.0, "args": {"name": f"gsc_tpu {run}"}})
+    for label, tid in TRACE_TRACKS.items():
+        out.append({"ph": "M", "name": "thread_name", "pid": TRACE_PID,
+                    "tid": tid, "ts": 0.0, "args": {"name": label}})
+
+    ep_tid = TRACE_TRACKS["episode"]
+    prev_phase_totals: Dict[str, float] = {}
+    prev_end = 0.0            # episode-track cursor (monotone)
+    compile_end = 0.0         # compile-track cursor
+    recoveries = [e for e in events if e.get("event") == "recovery"]
+    rec_index = {id(e): i for i, e in enumerate(recoveries)}
+    flow_id = 0
+
+    for ev in events:
+        kind = ev.get("event")
+        ts_us = _us(float(ev["ts"]), t0)
+        if kind == "run_start":
+            prev_phase_totals = {}
+            prev_end = max(prev_end, ts_us)
+            push("i", "run_start", ep_tid, ts_us, s="t",
+                 args={k: v for k, v in ev.items()
+                       if k in ("run", "episodes", "replicas", "pipeline",
+                                "precision", "substep_impl", "mesh")})
+        elif kind == "episode":
+            start = max(prev_end, 0.0)
+            end = max(ts_us, start)
+            push("B", f"episode {ev.get('episode')}", ep_tid, start,
+                 args={"episode": ev.get("episode"), "sps": ev.get("sps"),
+                       "return": ev.get("episodic_return")})
+            totals = {n: i.get("total_s", 0.0)
+                      for n, i in (ev.get("phases") or {}).items()}
+            deltas = {n: max(t - prev_phase_totals.get(n, 0.0), 0.0)
+                      for n, t in totals.items()}
+            prev_phase_totals = totals
+            order = [p for p in _TRACE_PHASES if deltas.get(p, 0) > 0] + \
+                sorted(set(deltas) - set(_TRACE_PHASES))
+            total_us = sum(deltas.get(p, 0.0) for p in order) * 1e6
+            span = end - start
+            scale = (span / total_us) if total_us > span else 1.0
+            cursor = start
+            for p in order:
+                d = round(deltas.get(p, 0.0) * 1e6 * scale, 1)
+                if d <= 0:
+                    continue
+                push("B", p, ep_tid, cursor,
+                     args={"delta_ms": round(deltas[p] * 1e3, 3)})
+                cursor = round(min(cursor + d, end), 1)
+                push("E", p, ep_tid, cursor)
+            push("E", f"episode {ev.get('episode')}", ep_tid, end)
+            prev_end = end
+        elif kind == "eval_episode":
+            start = max(prev_end,
+                        ts_us - round(float(ev.get("runtime_s") or 0.0)
+                                      * 1e6, 1))
+            end = max(ts_us, start)
+            push("B", f"eval {ev.get('episode')}", ep_tid, start,
+                 args={"return": ev.get("episodic_return"),
+                       "succ_ratio": ev.get("succ_ratio")})
+            push("E", f"eval {ev.get('episode')}", ep_tid, end)
+            prev_end = end
+        elif kind == "run_end":
+            push("i", f"run_end ({ev.get('status')})", ep_tid,
+                 max(ts_us, prev_end), s="t")
+            prev_end = max(ts_us, prev_end)
+        elif kind == "stall":
+            push("i", "stall", TRACE_TRACKS["watchdog"], ts_us, s="g",
+                 args={"age_s": ev.get("age_s"),
+                       "budget_s": ev.get("budget_s"),
+                       "last_phase": ev.get("last_phase"),
+                       "dispatch_drain_lag": ev.get("dispatch_drain_lag")})
+        elif kind == "escalation":
+            push("i", "escalation", TRACE_TRACKS["watchdog"], ts_us,
+                 s="g", args={"age_s": ev.get("age_s"),
+                              "action": ev.get("action")})
+        elif kind == "invariant_violation":
+            push("i", "invariant_violation", TRACE_TRACKS["watchdog"],
+                 ts_us, s="t",
+                 args={"episode": ev.get("episode"),
+                       "violations": len(ev.get("violations") or [])})
+        elif kind == "recovery":
+            name = f"{ev.get('site')}/{ev.get('action')}"
+            i = rec_index[id(ev)]
+            nxt = (_us(float(recoveries[i + 1]["ts"]), t0)
+                   if i + 1 < len(recoveries) else ts_us + 1000.0)
+            dur = round(max(min(1000.0, nxt - ts_us), 0.0), 1)
+            tid = TRACE_TRACKS["recovery"]
+            push("B", name, tid, ts_us,
+                 args={"episode": ev.get("episode"),
+                       "fault": ev.get("fault"),
+                       "detail": ev.get("detail")})
+            # flow arrows chain the ladder: this action -> the next one
+            if i + 1 < len(recoveries):
+                flow_id += 1
+                push("s", "ladder", tid, ts_us, id=flow_id)
+                push("f", "ladder", tid, nxt, id=flow_id, bp="e")
+            push("E", name, tid, round(ts_us + dur, 1))
+            if ev.get("site") == "prefetcher":
+                push("i", ev.get("action") or "restart",
+                     TRACE_TRACKS["prefetcher"], ts_us, s="t",
+                     args={"episode": ev.get("episode")})
+        elif kind == "compile":
+            dur = round(float(ev.get("duration_s") or 0.0) * 1e6, 1)
+            start = max(compile_end, ts_us - dur)
+            end = max(ts_us, start)
+            push("B", f"{ev.get('fn')} [{ev.get('stage')}]",
+                 TRACE_TRACKS["compile"], start,
+                 args={"count": ev.get("count")})
+            push("E", f"{ev.get('fn')} [{ev.get('stage')}]",
+                 TRACE_TRACKS["compile"], end)
+            compile_end = end
+        elif kind == "compile_cost":
+            push("i", f"cost {ev.get('fn')}", TRACE_TRACKS["compile"],
+                 max(ts_us, compile_end), s="t",
+                 args={"flops": ev.get("flops"),
+                       "bytes_accessed": ev.get("bytes_accessed"),
+                       "fusions": ev.get("fusions")})
+            compile_end = max(ts_us, compile_end)
+        elif kind == "serve_start":
+            push("i", "serve_start", TRACE_TRACKS["serve"], ts_us, s="t",
+                 args={"tier": ev.get("tier"),
+                       "startup_s": ev.get("startup_s")})
+        elif kind == "serve_stats":
+            push("C", "serve", TRACE_TRACKS["serve"], ts_us,
+                 args={"rps": float(ev.get("rps") or 0.0),
+                       "p99_ms": float(ev.get("p99_ms") or 0.0),
+                       "queue_depth": float(ev.get("queue_depth") or 0)})
+        # other event kinds (precision, harness_episode, ...) carry no
+        # timeline geometry — the report renders them, the trace skips them
+
+    # flows ride INSIDE slices; keep pairs adjacent under the stable sort
+    order_key = {"M": 0}
+    out.sort(key=lambda e: (e.get("ts", 0.0),
+                            order_key.get(e.get("ph"), 1)))
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "metadata": {"run": run, "exporter": "gsc_tpu.obs.trace",
+                         "t0_unix_s": t0}}
+
+
+def validate_trace(trace: Dict) -> List[str]:
+    """Strict schema check; returns a list of problems (empty = valid).
+
+    Rules: every event carries ph/name/pid/tid and a numeric ts >= 0;
+    events are globally sorted by ts; per (pid, tid) the B/E events form
+    a properly nested stack (names match, nothing left open); "X" events
+    need dur >= 0; every flow start ("s") has a matching finish ("f")."""
+    errors: List[str] = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    stacks: Dict[tuple, List[str]] = {}
+    flows_open: Dict[object, int] = {}
+    last_ts = None
+    for i, ev in enumerate(events):
+        for field in ("ph", "name", "pid", "tid"):
+            if field not in ev:
+                errors.append(f"event {i}: missing {field!r}")
+        ph = ev.get("ph")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if ph != "M":
+            if last_ts is not None and ts < last_ts:
+                errors.append(f"event {i}: ts {ts} < previous {last_ts} "
+                              "(stream not monotone)")
+            last_ts = ts
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev.get("name"))
+        elif ph == "E":
+            stack = stacks.get(key) or []
+            if not stack:
+                errors.append(f"event {i}: E with empty stack on {key}")
+            else:
+                top = stack.pop()
+                if ev.get("name") and ev["name"] != top:
+                    errors.append(f"event {i}: E {ev['name']!r} does not "
+                                  f"match open B {top!r} on {key}")
+        elif ph == "X":
+            if not isinstance(ev.get("dur"), (int, float)) \
+                    or ev["dur"] < 0:
+                errors.append(f"event {i}: X with bad dur {ev.get('dur')!r}")
+        elif ph == "s":
+            flows_open[ev.get("id")] = flows_open.get(ev.get("id"), 0) + 1
+        elif ph == "f":
+            if flows_open.get(ev.get("id"), 0) <= 0:
+                errors.append(f"event {i}: flow finish without start "
+                              f"(id {ev.get('id')!r})")
+            else:
+                flows_open[ev["id"]] -= 1
+    for key, stack in stacks.items():
+        if stack:
+            errors.append(f"unclosed B events on {key}: {stack}")
+    for fid, n in flows_open.items():
+        if n:
+            errors.append(f"flow start without finish (id {fid!r})")
+    return errors
+
+
+def export_trace(src: str, out_path: Optional[str] = None):
+    """events.jsonl (or run dir) -> validated trace dict; optionally
+    written to ``out_path``.  Returns ``(trace, errors)`` — the caller
+    decides whether a non-empty error list is fatal."""
+    trace = build_trace(read_events(src))
+    errors = validate_trace(trace)
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+    return trace, errors

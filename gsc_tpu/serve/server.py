@@ -36,7 +36,8 @@ import numpy as np
 from .batcher import MicroBatcher, ServeFuture
 from .cache import ArtifactCache, cache_material
 from .fallback import SPRFallbackPolicy
-from .policy import GreedyServePolicy, exec_fn_name
+from .policy import (GreedyServePolicy, exec_fn_name, policy_fn_name,
+                     shape_structs)
 
 log = logging.getLogger("gsc_tpu.serve.server")
 
@@ -68,7 +69,7 @@ class PolicyServer:
                  precision: str = "f32", substep_impl: str = "xla",
                  graph_mode: bool = True,
                  hub=None, stats_interval: int = 50,
-                 max_queue: int = 4096):
+                 max_queue: int = 4096, perf=None):
         if (policy is None) == (fallback is None):
             raise ValueError("exactly one of policy (learned tier, with "
                              "params) or fallback (SPR tier) is required")
@@ -86,6 +87,11 @@ class PolicyServer:
         self.substep_impl = substep_impl
         self.graph_mode = graph_mode
         self.hub = hub
+        # device-cost ledger (obs.perf.CostLedger): with one, every bucket
+        # records its serve_policy_b<B> compile cost at start() and the
+        # measured latency histograms merge in at close() — perf.json
+        # then carries per-bucket MFU next to the training entry points
+        self.perf = perf
         self.stats_interval = max(int(stats_interval), 1)
         self.max_queue = max_queue
         self.batcher: Optional[MicroBatcher] = None
@@ -159,6 +165,15 @@ class PolicyServer:
                 self.cache.store(material, bytes(exported.serialize()))
         self._exec[b] = _make_exec(exported, exec_fn_name(b))
         self._warm_bucket(b)
+        if self.perf is not None:
+            # shapes-only AOT capture of the bucket's compiled policy —
+            # FLOPs/bytes/fusions per batched call at startup, never
+            # inside a request's latency (the warm call above already
+            # paid the backend compile, so this lower mostly re-wraps it)
+            self.perf.capture(
+                policy_fn_name(b), self._exec[b],
+                (shape_structs(self.params),
+                 *self.policy.template.batch_structs(b)))
         return {"cache_hit": hit,
                 "prepare_s": round(time.perf_counter() - t0, 3)}
 
@@ -177,6 +192,19 @@ class PolicyServer:
             self.batcher.stop()
             self.batcher = None
         self._emit_stats(final=True)
+        if self.perf is not None and self.hub is not None:
+            # measured per-bucket FLUSH wall -> ledger timings: the
+            # batcher's serve_batch_ms histogram wraps exactly one
+            # device call per observation (run_batch in _flush), so
+            # `dispatches` counts device calls — not requests — and
+            # wall_s_mean is honest per-dispatch wall.  It still
+            # includes host staging around the call, so the derived MFU
+            # is a serving lower bound, not a kernel-only number.
+            for b in self.buckets:
+                s = self.hub.histogram_summary("serve_batch_ms", bucket=b)
+                if s and s.get("count"):
+                    self.perf.note_timing(policy_fn_name(b),
+                                          s["sum"] / 1e3, int(s["count"]))
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,537 @@
+"""Cross-run perf regression tracker over the banked bench artifacts.
+
+The repo accumulates a perf trajectory nobody reads mechanically:
+``BENCH_r0*.json`` (env-steps/s ladder rounds), ``MULTICHIP_r0*.json``
+(mesh-carving bit-equality matrices), ``SERVE_r0*.json`` (latency-SLA
+legs) and now the per-run ``perf.json`` cost ledgers (gsc_tpu.obs.perf).
+This tool makes that trajectory a guarded artifact:
+
+- **ingest**: normalize any mix of those files into rows of one
+  cumulative ``BENCH_TRAJECTORY.json`` (schema-versioned, keyed by
+  artifact name; re-ingesting updates in place);
+- **diff**: compare a current row (by name, or straight from a file)
+  against a NAMED BASELINE row with per-metric tolerance bands, exit
+  nonzero on any regression — the fusion-budget discipline of the
+  megakernel PR, generalized to every perf-relevant number.
+
+Verdicts per metric: ``ok`` (within band), ``improved``, ``regression``
+(beyond band in the bad direction), ``missing`` (only one side has it —
+informational, never fatal).  Overall verdict is ``regression`` iff any
+metric regressed; a baseline name that is not in the trajectory is the
+distinct ``missing-baseline`` verdict (exit 3), so CI can tell "got
+slower" from "never measured".
+
+Exit codes: 0 ok/improved, 1 regression, 2 usage/parse error,
+3 missing baseline.
+
+Usage:
+    python tools/bench_diff.py ingest --scan . --out BENCH_TRAJECTORY.json
+    python tools/bench_diff.py ingest results/run1/perf.json
+    python tools/bench_diff.py diff BENCH_r04 --baseline BENCH_r03
+    python tools/bench_diff.py diff results/run2/perf.json \
+        --baseline perf_run1 --tolerance mfu=0.3
+    python tools/bench_diff.py --selftest
+
+Stdlib only: this must run on a login node with no JAX installed.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+TRAJECTORY_SCHEMA_VERSION = 1
+
+# metric gating rules, matched by key SUFFIX (first match wins):
+# (suffix, higher_is_better, relative tolerance band).  A metric with no
+# matching rule is carried in the rows but never gated — flops/bytes
+# legitimately move when the model changes; rates/latencies/fusion
+# counts are the contract.
+METRIC_RULES: List[Tuple[str, bool, float]] = [
+    ("env_steps_per_sec", True, 0.10),
+    ("vs_baseline", True, 0.10),
+    ("rps", True, 0.15),
+    ("p99_ms", False, 0.25),
+    ("p50_ms", False, 0.25),
+    ("mfu", True, 0.15),
+    ("sps", True, 0.15),             # mixtopo mixed/homogeneous rates
+    ("fusions", False, 0.05),
+    ("jit_traces", False, 0.0),      # any retrace growth is churn
+    ("legs_ok", True, 0.0),
+    ("bit_equal", True, 0.0),
+    ("cold_start_s", False, 0.25),
+    ("cache_hit_start_s", False, 0.25),
+]
+
+# filename patterns `ingest --scan` picks up.  perf.json ledgers are
+# searched RECURSIVELY: runs write them at results/<id>/<timestamp>/
+# (utils.experiment.setup_result_dir layout), arbitrarily deep below
+# the scan root.
+SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
+                 "MIXTOPO_r*.json", "**/perf.json")
+
+
+def metric_rule(name: str) -> Optional[Tuple[bool, float]]:
+    for suffix, higher, tol in METRIC_RULES:
+        if name.endswith(suffix):
+            return higher, tol
+    return None
+
+
+# ------------------------------------------------------------- extraction
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def _bench_row(d: Dict) -> Dict:
+    """A bench.py artifact line (possibly a driver wrapper's `parsed`)."""
+    status = d.get("status") or ("failed" if d.get("error") else "ok")
+    metrics: Dict[str, float] = {}
+    if status == "ok":
+        if _num(d.get("value")) is not None:
+            metrics["env_steps_per_sec"] = float(d["value"])
+        if _num(d.get("vs_baseline")) is not None:
+            metrics["vs_baseline"] = float(d["vs_baseline"])
+        # MIXTOPO rounds share the metric name but report paired rates
+        for k in ("mixed_sps", "homogeneous_sps", "mixed_vs_homogeneous"):
+            if _num(d.get(k)) is not None:
+                metrics[k] = float(d[k])
+        for fn, n in (d.get("jit_traces") or {}).items():
+            if _num(n) is not None:
+                metrics[f"{fn}_jit_traces"] = float(n)
+        # MIXTOPO rounds record per-leg trace counts; keys end in
+        # `_jit_traces` so the 0%-tolerance retrace band gates them too
+        for leg in ("homogeneous", "mixed"):
+            for fn, n in (d.get(f"jit_traces_{leg}") or {}).items():
+                if _num(n) is not None:
+                    metrics[f"{leg}_{fn}_jit_traces"] = float(n)
+        for fn, cost in (d.get("cost") or {}).items():
+            for k in ("fusions", "mfu", "flops", "bytes_accessed"):
+                if _num((cost or {}).get(k)) is not None:
+                    metrics[f"{fn}_{k}"] = float(cost[k])
+    return {"kind": "bench", "status": status, "metrics": metrics,
+            "context": {k: d.get(k) for k in
+                        ("pipeline", "precision", "substep_impl", "unroll",
+                         "mesh", "topo_mix") if k in d}}
+
+
+def _multichip_row(d: Dict) -> Dict:
+    metrics: Dict[str, float] = {}
+    for k in ("legs_ok", "legs_total", "devices"):
+        if _num(d.get(k)) is not None:
+            metrics[k] = float(d[k])
+    if "bit_equal_across_carvings" in d:
+        metrics["bit_equal"] = 1.0 if d["bit_equal_across_carvings"] else 0.0
+    walls = [leg.get("wall_s") for leg in d.get("legs") or []
+             if _num(leg.get("wall_s")) is not None]
+    if walls:
+        metrics["mean_leg_wall_s"] = round(sum(walls) / len(walls), 3)
+    return {"kind": "multichip", "status": d.get("status", "ok"),
+            "metrics": metrics, "context": {"mode": d.get("mode")}}
+
+
+def _serve_row(d: Dict) -> Dict:
+    metrics: Dict[str, float] = {}
+    for k in ("cold_start_s", "cache_hit_start_s"):
+        if _num(d.get(k)) is not None:
+            metrics[k] = float(d[k])
+    legs = d.get("legs") or {}
+    for leg_name, leg in legs.items():
+        for k in ("rps", "p50_ms", "p99_ms"):
+            if _num((leg or {}).get(k)) is not None:
+                metrics[f"{leg_name}_{k}"] = float(leg[k])
+    # flat single-run serve JSON (cli serve output) has rps/p99 top-level
+    for k in ("rps", "p50_ms", "p99_ms"):
+        if _num(d.get(k)) is not None:
+            metrics[k] = float(d[k])
+    return {"kind": "serve", "status": d.get("status", "ok"),
+            "metrics": metrics,
+            "context": {k: d.get(k) for k in ("tier", "buckets", "platform")
+                        if k in d}}
+
+
+def _perf_row(d: Dict) -> Dict:
+    """A gsc_tpu.obs.perf ledger (perf.json)."""
+    metrics: Dict[str, float] = {}
+    for name, e in (d.get("entries") or {}).items():
+        if not (e or {}).get("available"):
+            continue
+        for k in ("fusions", "mfu", "flops", "bytes_accessed",
+                  "arithmetic_intensity", "wall_s_mean"):
+            if _num(e.get(k)) is not None:
+                metrics[f"{name}_{k}"] = float(e[k])
+    return {"kind": "perf_ledger", "status": "ok", "metrics": metrics,
+            "context": {"backend": d.get("backend"), "run": d.get("run"),
+                        "ledger_schema": d.get("schema_version")}}
+
+
+def extract_row(path: str) -> Optional[Dict]:
+    """Classify + normalize one artifact file; None if unrecognized."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[bench_diff] skipping {path}: {e}", file=sys.stderr)
+        return None
+    if not isinstance(d, dict):
+        return None
+    # driver wrapper rounds bank the artifact line under "parsed"; a
+    # wrapper whose run produced no parseable line at all is still a
+    # FAILED bench row (round never ran != round was slow)
+    if "parsed" in d:
+        if not isinstance(d["parsed"], dict):
+            d = {"metric": "env_steps_per_sec_per_chip",
+                 "status": "failed"}
+        else:
+            d = d["parsed"]
+    if d.get("metric") == "env_steps_per_sec_per_chip":
+        row = _bench_row(d)
+    elif d.get("metric") == "serve_requests_per_sec" or (
+            "legs" in d and "cache_hit_start_s" in d):
+        row = _serve_row(d)
+    elif d.get("mode") == "mesh_matrix" or "bit_equal_across_carvings" in d:
+        row = _multichip_row(d)
+    elif "schema_version" in d and "entries" in d:
+        row = _perf_row(d)
+    else:
+        return None
+    base = os.path.basename(path)
+    name = os.path.splitext(base)[0]
+    if name == "perf":
+        # per-run ledgers all share the filename; key by run dir (or the
+        # ledger's recorded run id) so two runs never collide
+        run = (row.get("context") or {}).get("run")
+        name = f"perf_{run or os.path.basename(os.path.dirname(os.path.abspath(path)))}"
+    row.update(name=name, source=path)
+    return row
+
+
+# -------------------------------------------------------------- trajectory
+def load_trajectory(path: str) -> Dict:
+    if path and os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema_version") != TRAJECTORY_SCHEMA_VERSION:
+            print(f"[bench_diff] {path} has schema "
+                  f"{doc.get('schema_version')!r}; rewriting as "
+                  f"v{TRAJECTORY_SCHEMA_VERSION}", file=sys.stderr)
+            doc = {"schema_version": TRAJECTORY_SCHEMA_VERSION,
+                   "rows": doc.get("rows", {})}
+        return doc
+    return {"schema_version": TRAJECTORY_SCHEMA_VERSION, "rows": {}}
+
+
+def write_trajectory(path: str, doc: Dict) -> str:
+    """Atomic rewrite (temp + os.replace) — same contract as the obs
+    snapshot writer, reimplemented here to stay stdlib-only."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path))
+                               or ".", prefix=".bench_traj.")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def ingest(paths: List[str], out: str, scan: Optional[str] = None) -> Dict:
+    doc = load_trajectory(out)
+    candidates = list(paths)
+    if scan:
+        for pattern in SCAN_PATTERNS:
+            candidates.extend(sorted(glob.glob(os.path.join(scan, pattern),
+                                               recursive=True)))
+    seen = set()
+    ingested = []
+    for p in candidates:
+        p = os.path.normpath(p)
+        if p in seen:
+            continue
+        seen.add(p)
+        row = extract_row(p)
+        if row is None:
+            continue
+        doc["rows"][row["name"]] = {k: v for k, v in row.items()
+                                    if k != "name"}
+        ingested.append(row["name"])
+    write_trajectory(out, doc)
+    print(f"[bench_diff] {out}: {len(doc['rows'])} row(s) "
+          f"({len(ingested)} ingested: {', '.join(ingested) or '-'})")
+    return doc
+
+
+# -------------------------------------------------------------------- diff
+def diff_rows(current: Dict, baseline: Dict,
+              tolerances: Optional[Dict[str, float]] = None) -> Dict:
+    """Per-metric verdicts for a (current, baseline) row pair.
+
+    A non-ok CURRENT row is its own overall verdict (``failed-current``,
+    gated like a regression): a crashed round has no measurements, and
+    diffing its empty metric set would otherwise come out clean — the
+    exact "never ran reads as fine" failure the status field exists to
+    prevent.  A non-ok BASELINE is ``failed-baseline`` (gated like
+    missing-baseline: there is nothing to regress against)."""
+    tolerances = tolerances or {}
+    if current.get("status", "ok") != "ok":
+        return {"verdict": "failed-current", "regressions": [],
+                "gated_metrics": 0, "current": current.get("name"),
+                "baseline": baseline.get("name"), "metrics": {}}
+    if baseline.get("status", "ok") != "ok":
+        return {"verdict": "failed-baseline", "regressions": [],
+                "gated_metrics": 0, "current": current.get("name"),
+                "baseline": baseline.get("name"), "metrics": {}}
+    cm, bm = current.get("metrics", {}), baseline.get("metrics", {})
+    per_metric = {}
+    regressions = []
+    for name in sorted(set(cm) | set(bm)):
+        rule = metric_rule(name)
+        if name not in cm or name not in bm:
+            per_metric[name] = {"verdict": "missing",
+                                "current": cm.get(name),
+                                "baseline": bm.get(name)}
+            continue
+        cur, base = cm[name], bm[name]
+        rec = {"current": cur, "baseline": base}
+        if base != 0:
+            rec["change_pct"] = round(100.0 * (cur - base) / abs(base), 2)
+        if rule is None:
+            rec["verdict"] = "informational"
+        else:
+            higher, tol = rule
+            tol = tolerances.get(name, tol)
+            delta = (cur - base) if higher else (base - cur)   # + is good
+            band = tol * abs(base)
+            if delta < -band - 1e-12:
+                rec["verdict"] = "regression"
+                rec["tolerance"] = tol
+                regressions.append(name)
+            elif delta > band + 1e-12:
+                rec["verdict"] = "improved"
+            else:
+                rec["verdict"] = "ok"
+        per_metric[name] = rec
+    gated = [m for m in per_metric
+             if per_metric[m]["verdict"] in ("ok", "improved", "regression")]
+    return {
+        "verdict": "regression" if regressions else "ok",
+        "regressions": regressions,
+        "gated_metrics": len(gated),
+        "current": current.get("name"),
+        "baseline": baseline.get("name"),
+        "metrics": per_metric,
+    }
+
+
+def resolve_row(spec: str, doc: Dict) -> Optional[Dict]:
+    """A row by trajectory name, or extracted fresh from a file path."""
+    row = doc.get("rows", {}).get(spec)
+    if row is not None:
+        return {**row, "name": spec}
+    if os.path.exists(spec):
+        return extract_row(spec)
+    # a path-like spec (results/run1/perf.json) that doesn't exist is a
+    # usage error, not a missing baseline
+    return None
+
+
+# ---------------------------------------------------------------- selftest
+def selftest() -> int:
+    import io
+    with tempfile.TemporaryDirectory() as tmp:
+        def dump(name, obj):
+            p = os.path.join(tmp, name)
+            with open(p, "w") as f:
+                json.dump(obj, f)
+            return p
+
+        good = dump("BENCH_r98.json", {
+            "metric": "env_steps_per_sec_per_chip", "status": "ok",
+            "value": 2000.0, "vs_baseline": 15.3, "unit": "env-steps/s",
+            "jit_traces": {"chunk_step": 1},
+            "cost": {"chunk_step": {"available": True, "fusions": 280,
+                                    "mfu": 0.02, "flops": 1e9}}})
+        slow = dump("BENCH_r99.json", {
+            "metric": "env_steps_per_sec_per_chip", "status": "ok",
+            "value": 1500.0, "vs_baseline": 11.5, "unit": "env-steps/s",
+            "jit_traces": {"chunk_step": 2},
+            "cost": {"chunk_step": {"available": True, "fusions": 310,
+                                    "mfu": 0.014, "flops": 1e9}}})
+        wrapper = dump("BENCH_r97.json", {   # driver wrapper + failed row
+            "n": 5, "rc": 1,
+            "parsed": {"metric": "env_steps_per_sec_per_chip",
+                       "value": 0.0, "error": "backend unreachable"}})
+        perf = dump("perf.json", {
+            "schema_version": 1, "backend": "cpu", "run": "selftest",
+            "entries": {"episode_step": {
+                "available": True, "flops": 6.6e6, "bytes_accessed": 6.7e6,
+                "fusions": 718, "mfu": 1e-4, "wall_s_mean": 1.3}}})
+        traj = os.path.join(tmp, "BENCH_TRAJECTORY.json")
+        doc = ingest([good, slow, wrapper, perf], traj)
+        assert set(doc["rows"]) == {"BENCH_r98", "BENCH_r99", "BENCH_r97",
+                                    "perf_selftest"}, doc["rows"].keys()
+        assert doc["rows"]["BENCH_r97"]["status"] == "failed"
+        assert doc["rows"]["perf_selftest"]["metrics"][
+            "episode_step_fusions"] == 718.0
+
+        # per-run ledgers live at results/<id>/<timestamp>/perf.json —
+        # `--scan` must find them recursively
+        nested = os.path.join(tmp, "results", "exp1", "ts1", "perf.json")
+        os.makedirs(os.path.dirname(nested))
+        with open(nested, "w") as f:
+            json.dump({"schema_version": 1, "backend": "cpu",
+                       "run": "nested",
+                       "entries": {"episode_step": {
+                           "available": True, "flops": 1.0,
+                           "fusions": 2}}}, f)
+        doc2 = ingest([], os.path.join(tmp, "t2.json"), scan=tmp)
+        assert "perf_nested" in doc2["rows"], doc2["rows"].keys()
+
+        # self-compare: identical rows must be clean
+        d = diff_rows({**doc["rows"]["BENCH_r98"], "name": "BENCH_r98"},
+                      {**doc["rows"]["BENCH_r98"], "name": "BENCH_r98"})
+        assert d["verdict"] == "ok" and not d["regressions"], d
+
+        # slower + more fusions + retrace growth vs the good round: every
+        # gated axis must flag
+        d = diff_rows({**doc["rows"]["BENCH_r99"], "name": "BENCH_r99"},
+                      {**doc["rows"]["BENCH_r98"], "name": "BENCH_r98"})
+        assert d["verdict"] == "regression", d
+        for m in ("env_steps_per_sec", "chunk_step_fusions",
+                  "chunk_step_mfu", "chunk_step_jit_traces"):
+            assert m in d["regressions"], (m, d["regressions"])
+        # flops unchanged and ungated
+        assert d["metrics"]["chunk_step_flops"]["verdict"] \
+            == "informational", d["metrics"]["chunk_step_flops"]
+
+        # the reverse direction is an improvement, not a regression
+        d = diff_rows({**doc["rows"]["BENCH_r98"], "name": "BENCH_r98"},
+                      {**doc["rows"]["BENCH_r99"], "name": "BENCH_r99"})
+        assert d["verdict"] == "ok" \
+            and d["metrics"]["env_steps_per_sec"]["verdict"] == "improved"
+
+        # a widened tolerance declassifies a small regression
+        d = diff_rows({"name": "a", "metrics": {"x_mfu": 0.9}},
+                      {"name": "b", "metrics": {"x_mfu": 1.0}},
+                      tolerances={"x_mfu": 0.5})
+        assert d["verdict"] == "ok", d
+
+        # failed rows never diff clean: a crashed current gates like a
+        # regression, a crashed baseline like a missing one
+        d = diff_rows({**doc["rows"]["BENCH_r97"], "name": "BENCH_r97"},
+                      {**doc["rows"]["BENCH_r98"], "name": "BENCH_r98"})
+        assert d["verdict"] == "failed-current", d
+        rc = main(["diff", "BENCH_r97", "--baseline", "BENCH_r98",
+                   "--trajectory", traj])
+        assert rc == 1, rc
+        rc = main(["diff", "BENCH_r98", "--baseline", "BENCH_r97",
+                   "--trajectory", traj])
+        assert rc == 3, rc
+
+        # CLI: missing baseline is its own verdict + exit code
+        rc = main(["diff", "BENCH_r98", "--baseline", "BENCH_r77",
+                   "--trajectory", traj])
+        assert rc == 3, rc
+        rc = main(["diff", "BENCH_r99", "--baseline", "BENCH_r98",
+                   "--trajectory", traj])
+        assert rc == 1, rc
+        rc = main(["diff", "BENCH_r98", "--baseline", "BENCH_r98",
+                   "--trajectory", traj])
+        assert rc == 0, rc
+    print("bench_diff selftest: OK")
+    return 0
+
+
+# --------------------------------------------------------------------- cli
+def _parse_tolerances(specs: List[str]) -> Dict[str, float]:
+    out = {}
+    for s in specs:
+        if "=" not in s:
+            raise SystemExit(f"--tolerance expects metric=frac, got {s!r}")
+        k, v = s.split("=", 1)
+        try:
+            out[k] = float(v)
+        except ValueError:
+            raise SystemExit(f"--tolerance {s!r}: {v!r} is not a number")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic-artifact verdict check (CI smoke)")
+    sub = ap.add_subparsers(dest="cmd")
+    ing = sub.add_parser("ingest", help="normalize artifacts into the "
+                                        "cumulative trajectory")
+    ing.add_argument("paths", nargs="*", help="artifact files")
+    ing.add_argument("--scan", default=None,
+                     help="also glob BENCH_r*/MULTICHIP_r*/SERVE_r*/"
+                          "perf.json under this directory")
+    ing.add_argument("--out", default="BENCH_TRAJECTORY.json")
+    dif = sub.add_parser("diff", help="current vs named baseline, exit "
+                                      "nonzero on regression")
+    dif.add_argument("current", help="trajectory row name or artifact path")
+    dif.add_argument("--baseline", required=True,
+                     help="trajectory row name (or artifact path)")
+    dif.add_argument("--trajectory", default="BENCH_TRAJECTORY.json")
+    dif.add_argument("--tolerance", action="append", default=[],
+                     metavar="METRIC=FRAC",
+                     help="override a metric's relative band "
+                          "(repeatable), e.g. --tolerance mfu=0.3")
+    dif.add_argument("--json", action="store_true",
+                     help="emit the full diff as JSON")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.cmd == "ingest":
+        if not args.paths and not args.scan:
+            ing.error("give artifact paths and/or --scan DIR")
+        ingest(args.paths, args.out, scan=args.scan)
+        return 0
+    if args.cmd == "diff":
+        doc = load_trajectory(args.trajectory)
+        current = resolve_row(args.current, doc)
+        if current is None:
+            print(f"bench_diff: current {args.current!r} is neither a "
+                  f"trajectory row nor a readable artifact",
+                  file=sys.stderr)
+            return 2
+        baseline = resolve_row(args.baseline, doc)
+        if baseline is None:
+            print(json.dumps({"verdict": "missing-baseline",
+                              "baseline": args.baseline,
+                              "known_rows": sorted(doc.get("rows", {}))}))
+            return 3
+        d = diff_rows(current, baseline,
+                      _parse_tolerances(args.tolerance))
+        if args.json:
+            print(json.dumps(d, indent=1))
+        else:
+            print(f"bench_diff: {d['current']} vs baseline "
+                  f"{d['baseline']}: {d['verdict'].upper()} "
+                  f"({d['gated_metrics']} gated metric(s))")
+            for name, rec in d["metrics"].items():
+                if rec["verdict"] in ("regression", "improved"):
+                    print(f"  {rec['verdict']:>11}  {name}: "
+                          f"{rec['baseline']} -> {rec['current']} "
+                          f"({rec.get('change_pct', '?')}%)")
+        # failed-current gates like a regression (a crashed round must
+        # not pass); failed-baseline like missing-baseline (nothing to
+        # regress against)
+        return {"regression": 1, "failed-current": 1,
+                "failed-baseline": 3}.get(d["verdict"], 0)
+    ap.error("give a subcommand (ingest | diff) or --selftest")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,14 @@ echo "== mixtopo smoke (mixed-topology batch: 2 networks, one dispatch) =="
 # plus the run_end status and the run_start topo_mix tag)
 env JAX_PLATFORMS=cpu python tools/mixtopo_smoke.py
 
+echo "== perfobs smoke (cost ledger -> perf.json + trace export + bench_diff) =="
+# a tiny train run must write a complete perf.json cost ledger (FLOPs/
+# bytes/fusions/MFU for episode_step), its rotated events stream must
+# export as VALID trace-event JSON, and bench_diff must self-compare
+# clean while failing an injected synthetic regression
+# (tools/perfobs_smoke.py asserts all three)
+env JAX_PLATFORMS=cpu python tools/perfobs_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -59,23 +59,48 @@ MEM_FLOOR_BYTES = 16 * 2 ** 20
 
 
 def load_events(path: str) -> List[Dict]:
-    """Accept a run dir or the events.jsonl itself; skip torn tail lines
+    """Accept a run dir or the events.jsonl itself; walk rotated segments
+    (``--obs-rotate-mb`` writes events.jsonl.N .. .1 before the live
+    file) oldest-first so the stream reads as one; skip torn tail lines
     (the stream may still be appending)."""
     if os.path.isdir(path):
         path = os.path.join(path, "events.jsonl")
-    if not os.path.exists(path):
+    older = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        older.append(f"{path}.{n}")
+        n += 1
+    segments = list(reversed(older)) + (
+        [path] if os.path.exists(path) else [])
+    if not segments:
         raise SystemExit(f"no events stream at {path}")
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue   # torn final line of a live run
+    for seg in segments:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue   # torn final line of a live run
     return events
+
+
+def load_perf(path: str) -> Optional[Dict]:
+    """The run's cost ledger (``perf.json``, gsc_tpu.obs.perf) if one was
+    written next to the event stream; None otherwise."""
+    if not os.path.isdir(path):
+        path = os.path.dirname(os.path.abspath(path))
+    p = os.path.join(path, "perf.json")
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def phase_deltas(episodes: List[Dict]) -> List[Dict[str, float]]:
@@ -137,8 +162,49 @@ def compile_summary(events: List[Dict],
     return {"per_fn": per_fn, "retrace_flags": flags}
 
 
+def perf_summary(perf: Optional[Dict]) -> Optional[Dict]:
+    """Condense a perf.json cost ledger for the report: one row per
+    watched entry point (FLOPs, bytes, fusions, MFU, roofline regime,
+    per-dispatch wall) plus the phase split and schema version."""
+    if not perf:
+        return None
+    rows = {}
+    for name, e in sorted((perf.get("entries") or {}).items()):
+        if not (e or {}).get("available"):
+            rows[name] = {"available": False, "error": (e or {}).get("error")}
+            continue
+        roof = e.get("roofline") or {}
+        rows[name] = {
+            "flops": e.get("flops"),
+            "bytes_accessed": e.get("bytes_accessed"),
+            "fusions": e.get("fusions"),
+            "dispatches": e.get("dispatches"),
+            "wall_ms_mean": (round(1e3 * e["wall_s_mean"], 3)
+                             if e.get("wall_s_mean") is not None else None),
+            "mfu": e.get("mfu"),
+            "regime": roof.get("regime"),
+            "roof_multiple": roof.get("roof_multiple"),
+        }
+    phases = perf.get("phases") or {}
+    dispatch_s = (phases.get("dispatch") or {}).get("total_s") or 0.0
+    host_s = sum((info or {}).get("total_s") or 0.0
+                 for name, info in phases.items() if name != "dispatch")
+    return {
+        "schema_version": perf.get("schema_version"),
+        "backend": perf.get("backend"),
+        "peaks": perf.get("peaks"),
+        "entries": rows,
+        # device-vs-host split: dispatch wall is time handing work to the
+        # device (covers device compute on a saturated pipeline), the
+        # rest is host-side sampling/draining
+        "device_vs_host": {"dispatch_s": round(dispatch_s, 4),
+                           "host_s": round(host_s, 4)},
+    }
+
+
 def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
-              retrace_threshold: int = 3) -> Dict:
+              retrace_threshold: int = 3,
+              perf: Optional[Dict] = None) -> Dict:
     runs_in_stream = max(
         sum(1 for e in events if e.get("event") == "run_start"), 1)
     events = last_run(events)
@@ -178,6 +244,13 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
                 "mean_ms": info.get("mean_ms"),
             }
 
+    # HBM-data availability: distinguish "no allocator stats on this
+    # backend" (CPU memory_stats() is None) from "usage was flat" — the
+    # device records carry available/backend either way
+    mem_unavailable = sorted({
+        rec.get("backend", "unknown")
+        for ev in episodes for rec in (ev.get("device_memory") or [])
+        if rec.get("available") is False})
     mem_flags = []
     for device, series in device_mem_series(episodes).items():
         if len(series) < 2:
@@ -283,8 +356,10 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "escalations": escalations,
         "recovery_totals": _recovery_totals(recoveries),
         "memory_growth_flags": mem_flags,
+        "memory_unavailable_backends": mem_unavailable,
         "drop_totals": _drop_totals(episodes),
         "compiles": compile_summary(events, retrace_threshold),
+        "perf": perf_summary(perf),
     }
 
 
@@ -319,6 +394,10 @@ def render_text(summary: Dict, out=sys.stdout):
     w = out.write
     w(f"run: {summary['run']}  episodes: {summary['episodes']}  "
       f"status: {summary['status']}\n")
+    perf = summary.get("perf")
+    if perf:
+        w(f"perf ledger: schema v{perf.get('schema_version')}  "
+          f"backend {perf.get('backend')}\n")
     prec = summary.get("precision")
     if prec:
         detail = ""
@@ -379,6 +458,27 @@ def render_text(summary: Dict, out=sys.stdout):
         for name, rec in sorted(summary["per_topology"].items()):
             w(f"  {name:<28} {rec['episodes']:>8} "
               f"{rec['mean_return']:>12} {rec['last_return']:>12}\n")
+    if perf and perf.get("entries"):
+        w("\nperf (device-cost ledger, per watched entry point):\n")
+        w(f"  {'entry':<20} {'flops':>12} {'bytes':>12} {'fusions':>8} "
+          f"{'disp':>6} {'wall_ms':>9} {'mfu':>10} {'regime':<14} "
+          f"{'roof_x':>8}\n")
+        for name, r in perf["entries"].items():
+            if not r.get("available", True):
+                w(f"  {name:<20} (cost model unavailable: "
+                  f"{r.get('error')})\n")
+                continue
+            w(f"  {name:<20} {_fmt(r.get('flops'), 12)} "
+              f"{_fmt(r.get('bytes_accessed'), 12)} "
+              f"{_fmt(r.get('fusions'), 8)} "
+              f"{_fmt(r.get('dispatches'), 6)} "
+              f"{_fmt(r.get('wall_ms_mean'), 9)} "
+              f"{r.get('mfu') if r.get('mfu') is not None else '-':>10} "
+              f"{(r.get('regime') or '-'):<14} "
+              f"{_fmt(r.get('roof_multiple'), 8)}\n")
+        dvh = perf.get("device_vs_host") or {}
+        w(f"  device-vs-host wall: dispatch {dvh.get('dispatch_s')}s / "
+          f"host {dvh.get('host_s')}s\n")
     w("\nper-phase host wall (cumulative):\n")
     for name, info in summary["phase_summary"].items():
         w(f"  {name:<18} total {info['total_s']:>9}s   "
@@ -434,6 +534,11 @@ def render_text(summary: Dict, out=sys.stdout):
         for m in summary["memory_growth_flags"]:
             w(f"  {m['device']}: {m['first_bytes']} -> {m['last_bytes']} "
               f"bytes (+{m['growth_pct']}%)\n")
+    if summary.get("memory_unavailable_backends"):
+        w("\ndevice memory: no HBM data — backend(s) "
+          f"{', '.join(summary['memory_unavailable_backends'])} report "
+          "no allocator stats (memory_stats() is None on CPU); flat "
+          "usage and missing data are NOT the same thing\n")
     if not (summary["stalls"] or summary["invariant_violations"]
             or summary["memory_growth_flags"]
             or summary.get("recoveries")
@@ -504,12 +609,18 @@ def _synthetic_events(path: str, episodes: int = 5):
                                    "count": ep + 1, "mean_ms": 10.0},
                       "drain": {"total_s": round(drain, 4),
                                 "count": ep + 1, "mean_ms": 2.0}},
-                  # 64 MiB -> 64+96*ep MiB: well past floor + threshold
+                  # 64 MiB -> 64+96*ep MiB: well past floor + threshold;
+                  # the second device has NO allocator stats (the CPU
+                  # memory_stats()=None shape) — the report must call
+                  # that out instead of reading it as flat usage
                   "device_memory": [{
                       "device": "FAKE_TPU_0", "available": True,
+                      "backend": "tpu",
                       "bytes_in_use": (64 + 96 * ep) * 2 ** 20,
                       "peak_bytes_in_use": 256 * 2 ** 20,
-                      "bytes_limit": 16 * 2 ** 30}]})
+                      "bytes_limit": 16 * 2 ** 30},
+                      {"device": "FAKE_CPU_0", "available": False,
+                       "backend": "cpu"}]})
         emit({"event": "stall", "ts": base + episodes, "run": "selftest",
               "age_s": 12.5, "budget_s": 10.0, "last_phase": "dispatch",
               "last_phase_state": "running", "episodes_dispatched": 5,
@@ -557,12 +668,54 @@ def _synthetic_events(path: str, episodes: int = 5):
               "run": "selftest", "status": "ok", "episodes": episodes})
 
 
+def _synthetic_perf(path: str):
+    """A cost-ledger document with the gsc_tpu.obs.perf schema."""
+    with open(path, "w") as f:
+        json.dump({
+            "schema_version": 1, "ts": 1_000_000_000.0, "backend": "cpu",
+            "peaks": {"flops_per_s": 5e10, "bytes_per_s": 2e10},
+            "run": "selftest",
+            "entries": {
+                "episode_step": {
+                    "available": True, "flops": 6668188.0,
+                    "bytes_accessed": 6770940.0, "fusions": 718,
+                    "ops": {"while": 21, "dot": 167},
+                    "arithmetic_intensity": 0.9848,
+                    "dispatches": 5, "wall_s_total": 0.05,
+                    "wall_s_mean": 0.01, "mfu": 0.0133,
+                    "roofline": {"intensity": 0.9848, "ridge": 2.5,
+                                 "regime": "memory_bound",
+                                 "roof_multiple": 29.5}},
+                "serve_policy_b8": {"available": False,
+                                    "error": "RuntimeError: no backend"},
+            },
+            "phases": {"dispatch": {"total_s": 0.05, "count": 5,
+                                    "mean_ms": 10.0},
+                       "drain": {"total_s": 0.01, "count": 5,
+                                 "mean_ms": 2.0}},
+        }, f)
+
+
 def selftest() -> int:
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "events.jsonl")
         _synthetic_events(path)
-        summary = summarize(load_events(path))
+        _synthetic_perf(os.path.join(tmp, "perf.json"))
+        summary = summarize(load_events(path), perf=load_perf(tmp))
         assert summary["episodes"] == 5, summary
+        # perf section: ledger rows condensed, schema version surfaced,
+        # the unavailable serve entry kept visible rather than dropped
+        pf = summary["perf"]
+        assert pf["schema_version"] == 1 and pf["backend"] == "cpu", pf
+        row = pf["entries"]["episode_step"]
+        assert row["fusions"] == 718 and row["mfu"] == 0.0133 \
+            and row["regime"] == "memory_bound" \
+            and row["wall_ms_mean"] == 10.0, row
+        assert pf["entries"]["serve_policy_b8"]["available"] is False
+        assert pf["device_vs_host"] == {"dispatch_s": 0.05,
+                                        "host_s": 0.01}, pf
+        # no-HBM-data flag: the CPU device reported available=False
+        assert summary["memory_unavailable_backends"] == ["cpu"], summary
         assert summary["precision"] == {
             "name": "bf16", "param_dtype": "float32",
             "gnn_compute": "bfloat16", "mlp_compute": "bfloat16",
@@ -586,6 +739,13 @@ def selftest() -> int:
         import io
         txt = io.StringIO()
         render_text(summary, out=txt)
+        assert "perf ledger: schema v1" in txt.getvalue(), \
+            "perf schema-version header not rendered"
+        assert "perf (device-cost ledger" in txt.getvalue() \
+            and "memory_bound" in txt.getvalue(), \
+            "perf section not rendered"
+        assert "no HBM data" in txt.getvalue(), \
+            "memory-unavailable note not rendered"
         assert "mesh: 4x2  rules: sharded" in txt.getvalue(), \
             "mesh header line not rendered"
         assert "topo mix: schedule,abilene+bursty" in txt.getvalue(), \
@@ -629,6 +789,20 @@ def selftest() -> int:
         s2 = summarize(load_events(path))
         assert s2["runs_in_stream"] == 2 and s2["episodes"] == 5, s2
         render_text(s2, out=open(os.devnull, "w"))
+        # rotation roundtrip (--obs-rotate-mb layout): split the stream
+        # into a .1 segment + live tail — the reader must walk the
+        # segments and reassemble the identical stream
+        lines = open(path).read().splitlines(keepends=True)
+        cut = len(lines) // 2
+        with open(path + ".1", "w") as f:
+            f.writelines(lines[:cut])
+        with open(path, "w") as f:
+            f.writelines(lines[cut:])
+        reassembled = [json.loads(line) for line in lines if line.strip()]
+        assert load_events(path) == reassembled, \
+            "rotated segments did not reassemble the stream"
+        s3 = summarize(load_events(path))
+        assert s3["runs_in_stream"] == 2 and s3["episodes"] == 5, s3
     print("obs_report selftest: OK")
     return 0
 
@@ -655,7 +829,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("path required (or --selftest)")
     summary = summarize(load_events(args.path),
                         mem_growth_threshold=args.mem_growth_threshold,
-                        retrace_threshold=args.retrace_threshold)
+                        retrace_threshold=args.retrace_threshold,
+                        perf=load_perf(args.path))
     if args.json:
         json.dump(summary, sys.stdout, indent=1)
         sys.stdout.write("\n")

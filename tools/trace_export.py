@@ -1,0 +1,75 @@
+"""Export a run's events.jsonl as Chrome/Perfetto trace-event JSON.
+
+Usage:
+    python tools/trace_export.py <run_dir | events.jsonl> [-o trace.json]
+    python tools/trace_export.py <run_dir> --validate-only
+
+Renders the obs event stream (``cli train`` / ``cli serve`` write it) into
+the trace-event format that https://ui.perfetto.dev and chrome://tracing
+open directly: one track per logical thread (episode loop, prefetcher,
+serve, watchdog, compile), watchdog stalls as instant events, recovery
+ladders chained by flow arrows — so a stall or pipeline bubble is visible
+on a timeline instead of inferred from log-line deltas.  Rotated streams
+(``--obs-rotate-mb``: events.jsonl.N..1) are walked transparently.
+
+The export always runs the strict validator
+(:func:`gsc_tpu.obs.trace.validate_trace`: monotone ts, matched B/E
+pairs, pid/tid on every event) and exits nonzero on any violation — CI's
+perfobs stage counts on that.  jax-free: only the obs package's pure
+rendering half is imported.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run directory or events.jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output trace path [default: <run_dir>/trace.json]")
+    ap.add_argument("--validate-only", action="store_true",
+                    help="build + validate without writing the trace file")
+    args = ap.parse_args(argv)
+
+    from gsc_tpu.obs.trace import build_trace, read_events, validate_trace
+
+    try:
+        events = read_events(args.path)
+    except FileNotFoundError as e:
+        print(f"trace_export: {e}", file=sys.stderr)
+        return 2
+    trace = build_trace(events)
+    errors = validate_trace(trace)
+    if errors:
+        print(f"trace_export: INVALID trace ({len(errors)} problem(s)):",
+              file=sys.stderr)
+        for err in errors[:20]:
+            print(f"  - {err}", file=sys.stderr)
+        return 1
+    n = len(trace["traceEvents"])
+    if args.validate_only:
+        print(f"trace_export: valid ({n} events)")
+        return 0
+    out = args.out
+    if out is None:
+        base = (args.path if os.path.isdir(args.path)
+                else os.path.dirname(os.path.abspath(args.path)))
+        out = os.path.join(base, "trace.json")
+    import json
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"trace_export: wrote {out} ({n} events) — open it at "
+          "https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
