@@ -48,6 +48,8 @@ from flax import struct
 from ..config.schema import AgentConfig
 from ..env.env import ServiceCoordEnv
 from ..models.nets import Actor, QNetwork, scale_action, unscale_action
+from ..obs.learning import (accumulate_signal, learn_signal, replay_stats,
+                            zero_learn_signal)
 from ..resilience.guard import all_finite
 from .buffer import ReplayBuffer, buffer_add, buffer_init, buffer_sample
 
@@ -88,10 +90,20 @@ class DDPG:
     """
 
     def __init__(self, env: ServiceCoordEnv, agent: AgentConfig,
-                 gnn_impl: str = None, donate: bool = False):
+                 gnn_impl: str = None, donate: bool = False,
+                 learn_ledger=None):
         self.env = env
         self.agent = agent
         self.donate = donate
+        # on-device learning-signal ledger (obs.learning.LearnLedgerSpec,
+        # static — it rides on `self`): with a spec, the learn burst and
+        # rollout fold per-topology |TD-error| segments, Q distribution
+        # moments, per-layer param/grad norms and replay fill stats into
+        # their EXISTING outputs (drained with the deferred drain, zero
+        # new host syncs).  None (the default) traces the historic
+        # programs byte for byte — the no-ledger path is the pre-ledger
+        # stack.
+        self.learn_ledger = learn_ledger
         self.action_dim = env.limits.action_dim
         gnn_impl = gnn_impl or agent.gnn_impl  # config-selected embedder
         sched_shape = env.limits.scheduling_shape
@@ -255,6 +267,11 @@ class DDPG:
             # post-update flag lives in the learn metrics)
             "state_finite": all_finite(state),
         }
+        if self.learn_ledger is not None:
+            # replay fill/age computed ON DEVICE from the post-rollout
+            # buffer (reading buffer.size host-side would sync the
+            # dispatch head); drained with the other deferred stats
+            episode_stats["replay"] = replay_stats(buffer)
         return state.replace(rng=rng), buffer, env_state, obs, episode_stats
 
     @partial(jax.jit, static_argnums=(0, 8))
@@ -311,7 +328,13 @@ class DDPG:
                                    batch["next_obs"], next_a)[..., 0]
         target = batch["reward"] + (1.0 - batch["done"]) * self.agent.gamma * q_next
         q = self.critic.apply(critic_params, batch["obs"], batch["action"])[..., 0]
-        return jnp.mean((q - jax.lax.stop_gradient(target)) ** 2), q
+        # the residual IS the loss argument — naming it changes no op.
+        # With the learn ledger the aux also carries it, so the burst can
+        # segment |TD| per topology without recomputing the targets;
+        # without a ledger the aux stays the historic single-tensor `q`.
+        td = q - jax.lax.stop_gradient(target)
+        aux = (q, td) if self.learn_ledger is not None else q
+        return jnp.mean(td ** 2), aux
 
     def _actor_loss(self, actor_params, critic_params, batch):
         a = self.actor.apply(actor_params, batch["obs"])
@@ -326,8 +349,9 @@ class DDPG:
 
     def gradient_step_on_batch(self, state: DDPGState, batch
                                ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
-        (critic_loss, q_vals), cgrad = jax.value_and_grad(
+        (critic_loss, aux), cgrad = jax.value_and_grad(
             self._critic_loss, has_aux=True)(state.critic_params, state, batch)
+        q_vals, td = aux if self.learn_ledger is not None else (aux, None)
         cupd, critic_opt = self.opt.update(cgrad, state.critic_opt)
         critic_params = optax.apply_updates(state.critic_params, cupd)
 
@@ -352,6 +376,14 @@ class DDPG:
                    "q_values": q_vals.mean(),
                    "critic_grad_norm": optax.global_norm(cgrad),
                    "actor_grad_norm": optax.global_norm(agrad)}
+        if self.learn_ledger is not None:
+            # learning-signal ledger (obs.learning): consumes tensors the
+            # update already materialized (td, grads, post-update params),
+            # so the update math is untouched either way
+            metrics["learn_signal"] = learn_signal(
+                self.learn_ledger, batch["topo_idx"], td, q_vals,
+                params={"actor": actor_params, "critic": critic_params},
+                grads={"actor": agrad, "critic": cgrad})
         return state, metrics
 
     def _learn_burst(self, state: DDPGState, sample_fn, constrain=None
@@ -371,11 +403,18 @@ class DDPG:
         state = state.replace(rng=sub)
 
         def body(i, carry):
-            st, _ = carry
+            st, acc = carry
             if constrain is not None:
                 st = constrain(st)
             batch = sample_fn(jax.random.fold_in(sub, i))
             st, metrics = self.gradient_step_on_batch(st, batch)
+            if self.learn_ledger is not None:
+                # TD segments ACCUMULATE across the burst (per-topology
+                # learning pressure over all sampled batches); moments
+                # and norms keep the last step's values — the same
+                # last-write carry semantics as the loss metrics
+                metrics = {**metrics, "learn_signal": accumulate_signal(
+                    acc["learn_signal"], metrics["learn_signal"])}
             if constrain is not None:
                 # pin the RETURNED carry too: the constraint on entry
                 # alone leaves the loop's back-edge free for GSPMD to
@@ -391,6 +430,9 @@ class DDPG:
                 "q_values": jnp.zeros(()),
                 "critic_grad_norm": jnp.zeros(()),
                 "actor_grad_norm": jnp.zeros(())}
+        if self.learn_ledger is not None:
+            zero["learn_signal"] = zero_learn_signal(self.learn_ledger,
+                                                     state)
         n_steps = (self.agent.learn_steps if self.agent.learn_steps
                    is not None else self.agent.episode_steps)
         state, metrics = jax.lax.fori_loop(0, n_steps, body, (state, zero))

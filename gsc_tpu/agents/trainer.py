@@ -115,11 +115,25 @@ class Trainer:
         # violations surface as structured ``invariant_violation`` events
         # (and WARNs) instead of a silently-returned list
         self.check_invariants = check_invariants
+        # learning-signal ledger (obs.learning): when the observer owns a
+        # LearnLedger, thread its STATIC spec into the jitted agents so
+        # the dispatched programs fold per-topology |TD| segments, Q
+        # moments, layer norms and replay stats into their existing
+        # outputs.  No observer / bare observer => spec None => the
+        # historic traces, byte for byte.
+        self.learn_obs = getattr(obs, "learn", None) \
+            if obs is not None else None
+        ledger_spec = None
+        if self.learn_obs is not None:
+            ledger_spec = self.learn_obs.spec(
+                getattr(driver, "num_topo_ids", 1),
+                getattr(driver, "topo_id_names", None))
         # donation is on by default: the training loops always rebind the
         # carries from the kernel returns, so in-place HBM updates of the
         # replay/env-state are safe; pass donate=False for comparison
         # drivers that re-call kernels on the same inputs
-        self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl, donate=donate)
+        self.ddpg = DDPG(env, agent_cfg, gnn_impl=gnn_impl, donate=donate,
+                         learn_ledger=ledger_spec)
         if self.obs is not None:
             # param/compute/replay dtype gauges + one precision event so
             # run-to-run throughput comparisons can attribute speedups to
@@ -185,6 +199,14 @@ class Trainer:
             # episode's compute (bench.py's bank() contract), not the
             # async-dispatch return time
             jax.block_until_ready((stats, learn_metrics, trunc_dev))
+            # learn-ledger extras are non-scalar (TD segment vectors,
+            # layer-norm dicts): split them off before the scalar row
+            # conversion below — already synced by the block above, so
+            # the host-side emit later reads them for free
+            replay = stats.pop("replay", None) \
+                if isinstance(stats, dict) else None
+            signal = learn_metrics.pop("learn_signal", None) \
+                if isinstance(learn_metrics, dict) else None
             steps_per_ep = self.agent_cfg.episode_steps
             sps = ((ep - start_episode + 1) * steps_per_ep
                    / (time.time() - start_time))
@@ -237,6 +259,12 @@ class Trainer:
                     self.obs.invariant_violation(ep, errs)
         if self.obs:
             row = self.history[-1]
+            # topology identity on the SERIAL path too: mixed batches get
+            # per-replica names through the harness, but a single-replica
+            # run's episodes must land in the same per-topology report
+            # tables — stamp the scheduled network's name on the event
+            # and gauge its return
+            extra = self._topology_extra(ep, row["episodic_return"])
             self.obs.episode_end(
                 episode=ep, global_step=end_step,
                 metrics={k: v for k, v in row.items()
@@ -245,7 +273,13 @@ class Trainer:
                 drop_reasons=dict(zip(
                     DROP_REASONS,
                     np.asarray(sim.metrics.drop_reasons).tolist())),
-                truncated_arrivals=trunc, replay_bytes=replay_bytes)
+                truncated_arrivals=trunc, replay_bytes=replay_bytes,
+                extra=extra)
+            if self.learn_obs is not None and (signal is not None
+                                               or replay is not None):
+                # drained learning signal -> learn_signal event + gauges
+                # (values synced above; nothing here waits on the device)
+                self.learn_obs.episode(ep, signal=signal, replay=replay)
         return finite
 
     # ---------------------------------------------------------- resilience
@@ -261,6 +295,23 @@ class Trainer:
         if self.obs is not None:
             self.obs.recovery(episode=episode, site=site, action=action,
                               fault=fault, attempt=attempt, detail=detail)
+
+    def _topology_extra(self, episode: int, episodic_return,
+                        extra: Optional[Dict] = None) -> Optional[Dict]:
+        """Topology identity for one drained episode (BOTH train paths):
+        gauge ``topology_return{topology=<name>}`` and return the episode
+        event's ``extra`` dict with the name stamped in — the one rule
+        behind the serial drain and the homogeneous replica loop, so the
+        per-topology tables obs_report merges can never diverge between
+        them.  No-op (returns ``extra`` unchanged) without an observer or
+        a nameable driver."""
+        namer = getattr(self.driver, "topology_name_for", None)
+        name = namer(episode) if namer is not None else None
+        if not name or self.obs is None:
+            return extra
+        self.obs.hub.gauge("topology_return", float(episodic_return),
+                           topology=name)
+        return {**(extra or {}), "topology": name}
 
     # -------------------------------------------------------- cost ledger
     @staticmethod
@@ -824,7 +875,12 @@ class Trainer:
         pddpg = ParallelDDPG(self.env, self.agent_cfg,
                              num_replicas=num_replicas, donate=True,
                              gnn_impl=self.ddpg.actor.gnn_impl, plan=plan,
-                             per_replica_topology=mix_plan is not None)
+                             per_replica_topology=mix_plan is not None,
+                             learn_ledger=self.ddpg.learn_ledger)
+        # learn-ledger segment names (topo_id -> name) for the harness's
+        # per-episode learn_signal emit; None without a ledger
+        seg_names = (self.learn_obs.segment_names
+                     if self.learn_obs is not None else None)
 
         def to_host(state, buffers):
             """Carries in the mesh-shape-agnostic host layout checkpoints
@@ -964,7 +1020,8 @@ class Trainer:
                     1, steps_per_ep, chunk, self.seed + ep,
                     step_offset=ep * steps_per_ep, hub=hub, timer=timer,
                     topo_names=(mix_plan.names if mix_plan is not None
-                                else None))
+                                else None),
+                    learn_names=seg_names)
                 sps = ((ep - start_episode + 1) * steps_per_ep
                        * num_replicas / (time.time() - start))
                 row = {"episodic_return": rets[0],
@@ -981,13 +1038,20 @@ class Trainer:
                     log.info("episode=%d return=%.3f succ=%.3f sps=%.1f",
                              ep, rets[0], succ[0], sps)
                 if self.obs:
+                    extra = {"replicas": num_replicas}
+                    if mix_plan is None:
+                        # homogeneous replica batches: one network per
+                        # episode — same stamp as the serial drain (the
+                        # harness's per-replica names cover mixes)
+                        extra = self._topology_extra(ep, rets[0],
+                                                     extra=extra)
                     self.obs.episode_end(
                         episode=ep, global_step=(ep + 1) * steps_per_ep - 1,
                         metrics={k: v for k, v in row.items()
                                  if k not in ("episode", "sps")},
                         sps=sps, phases=timer.summary(),
                         replay_bytes=buffer_nbytes(buffers),
-                        extra={"replicas": num_replicas})
+                        extra=extra)
                 self._last_drained = ep
                 if (ckpt_manager is not None and ckpt_interval
                         and (ep + 1 - start_episode) % ckpt_interval == 0):

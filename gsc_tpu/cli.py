@@ -345,6 +345,25 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "metrics.json (tools/bench_diff.py diffs them across "
                    "runs).  Costs one extra AOT trace per entry point at "
                    "startup; adds nothing to the dispatch path")
+@click.option("--learn-obs/--no-learn-obs", "learnobs_enabled",
+              default=True, show_default=True,
+              help="on-device learning-signal ledger: per-topology "
+                   "|TD-error| segments (segment_sum over the replay "
+                   "rows' topo_idx), Q-value distribution moments, "
+                   "per-layer param/grad norms and replay fill/age — "
+                   "computed INSIDE the dispatched programs and drained "
+                   "with the deferred metric drain (zero new host "
+                   "syncs).  Lands as learn_signal events + tagged "
+                   "gauges; RunObserver.close() extracts schema-"
+                   "versioned curves.json that tools/bench_diff.py "
+                   "gates (final-window return, AUC, episodes-to-"
+                   "threshold)")
+@click.option("--metrics-port", default=0, show_default=True,
+              help="live Prometheus /metrics endpoint over the run's "
+                   "MetricsHub (stdlib HTTP server on 127.0.0.1) so a "
+                   "long run can be scraped WHILE it executes: curl "
+                   "http://127.0.0.1:<port>/metrics.  0 = disabled; the "
+                   "bound port is recorded as a metrics_endpoint event")
 @click.option("--watchdog-budget", default=300.0, show_default=True,
               help="seconds without a completed episode before the "
                    "pipeline watchdog emits a structured 'stall' event "
@@ -387,7 +406,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           profile, runs, resume, resource_functions_path, replicas, chunk,
           mesh, partition_rules, topo_mix, pipeline, precision,
           substep_impl, unroll, obs_enabled, obs_dir, obs_interval,
-          obs_rotate_mb, perf_enabled, watchdog_budget, watchdog_escalate,
+          obs_rotate_mb, perf_enabled, learnobs_enabled, metrics_port,
+          watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
           ckpt_retain, jax_cache_dir, verbose):
     """Train DDPG, checkpoint, then one greedy test episode
@@ -410,6 +430,14 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
     jax_cache_dir = _apply_jax_cache(jax_cache_dir)
     if resume and runs != 1:
         raise click.BadParameter("--resume only supports --runs 1")
+    if metrics_port < 0:
+        raise click.BadParameter("--metrics-port must be >= 0 "
+                                 "(0 = disabled)")
+    if metrics_port and not obs_enabled:
+        # same contract as cli serve: a port that silently never binds
+        # would leave a scraper on connection-refused all run long
+        raise click.BadParameter("--metrics-port needs the run observer "
+                                 "(drop --no-obs)")
     if unroll is not None and unroll < 1:
         # same contract as bench.py's --unroll: fail fast with the flag's
         # name, not a SimConfig traceback from deep inside the run loop
@@ -570,6 +598,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                               watchdog_budget_s=watchdog_budget,
                               watchdog_escalate=watchdog_escalate,
                               rotate_mb=obs_rotate_mb, perf=perf_enabled,
+                              learn=learnobs_enabled,
+                              metrics_port=(metrics_port or None),
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
@@ -824,12 +854,18 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
                    "serve_policy_b<B> records compiled FLOPs/bytes/"
                    "fusions at start() and its measured latency merges "
                    "in at close() — perf.json lands next to metrics.json")
+@click.option("--metrics-port", default=0, show_default=True,
+              help="live Prometheus /metrics endpoint over the serving "
+                   "hub (the same endpoint cli train exposes): latency "
+                   "histograms, queue depth and bucket occupancy are "
+                   "scrapeable while the server runs.  0 = disabled; "
+                   "requires --obs")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 def serve(agent_config, simulator_config, service, scheduler, checkpoint,
           requests, concurrency, buckets, deadline_ms, artifact_cache,
           pool_steps, stats_interval, request_timeout, seed, max_nodes,
           max_edges, resource_functions_path, result_dir, obs_enabled,
-          obs_dir, perf_enabled, jax_cache_dir):
+          obs_dir, perf_enabled, metrics_port, jax_cache_dir):
     """Serve coordination decisions from an AOT-compiled greedy policy.
 
     With CHECKPOINT: restores the actor, ahead-of-time compiles the
@@ -865,6 +901,12 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     if requests < 1 or concurrency < 1:
         raise click.BadParameter("--requests and --concurrency must be "
                                  "positive")
+    if metrics_port < 0:
+        raise click.BadParameter("--metrics-port must be >= 0 "
+                                 "(0 = disabled)")
+    if metrics_port and not obs_enabled:
+        raise click.BadParameter("--metrics-port needs the run observer "
+                                 "(drop --no-obs)")
     jax_cache_dir = _apply_jax_cache(jax_cache_dir)
 
     precision = None
@@ -900,7 +942,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     if obs_enabled:
         from .obs import RunObserver
         obs_rec = RunObserver(obs_dir or rdir, tags={"seed": seed},
-                              perf=perf_enabled)
+                              perf=perf_enabled,
+                              metrics_port=(metrics_port or None))
         obs_rec.start(meta={
             "mode": "serve", "tier": tier, "seed": seed,
             "requests": requests, "concurrency": concurrency,

@@ -140,6 +140,57 @@ class EpisodeDriver:
         index = (episode // self.scheduler.period) % len(self.topologies)
         return self.topologies[index]
 
+    # ------------------------------------------------- topology identity
+    # (the obs layer's attribution surface: replay rows store topo_id,
+    # and these map ids back to names so single-replica runs land in the
+    # same per-topology report tables as mixed batches)
+    @property
+    def num_topo_ids(self) -> int:
+        """How many distinct ``topo_id`` values this driver's episodes
+        can stamp into replay rows: mix-entry count for mixed runs,
+        schedule length otherwise (the learn ledger's segment axis).
+        ``getattr`` tolerates stub drivers built via ``__new__`` (the
+        test suite's single-topology fakes)."""
+        entries = getattr(self, "_mix_entries", None)
+        if entries is not None:
+            return len(entries)
+        return len(self.topologies)
+
+    def _schedule_names(self) -> List[str]:
+        """Schedule-position -> name (file basenames; drivers built from
+        explicit topology lists fall back to positional names).  The ONE
+        naming rule behind :attr:`topo_id_names` and
+        :meth:`topology_name_for`, so the learn ledger's segment names
+        and the episode-event topology stamps can never disagree."""
+        files = list(self.scheduler.training_network_files or [])
+        if len(files) == len(self.topologies):
+            return [os.path.basename(p) for p in files]
+        return [f"topology{i}" for i in range(len(self.topologies))]
+
+    @property
+    def topo_id_names(self) -> List[str]:
+        """``topo_id`` -> human-readable name, aligned with
+        :attr:`num_topo_ids` (mix-entry names, else the schedule
+        names)."""
+        entries = getattr(self, "_mix_entries", None)
+        if entries is not None:
+            return [e.name for e in entries]
+        return self._schedule_names()
+
+    def topology_name_for(self, episode: int,
+                          test_mode: bool = False) -> str:
+        """Name of the topology :meth:`topology_for` yields — the serial
+        trainer stamps it on episode events / ``topology_return`` gauges
+        so single-replica runs appear in the same per-topology tables as
+        mixed batches.  (Schedule names, NOT :attr:`topo_id_names`: a
+        mixed driver's id axis is mix entries, but this method describes
+        the schedule pick the non-mixed paths dispatch.)"""
+        if test_mode:
+            return os.path.basename(self.scheduler.inference_network or
+                                    "inference")
+        index = (episode // self.scheduler.period) % len(self.topologies)
+        return self._schedule_names()[index]
+
     def traffic_for(self, episode: int, topo: Topology,
                     seed: Optional[int] = None) -> TrafficSchedule:
         seed = self.base_seed + episode if seed is None else seed

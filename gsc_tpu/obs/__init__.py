@@ -31,6 +31,19 @@ pipelines honest; this package is that substrate:
   drained wall timings into per-dispatch MFU and roofline position;
   serialized as the schema-versioned per-run ``perf.json``
   (``tools/bench_diff.py`` diffs them across runs).
+- :class:`LearnLedger` (:mod:`~gsc_tpu.obs.learning`) — the on-device
+  learning-signal ledger: per-topology |TD-error| segments, Q-value
+  distribution moments, per-layer param/grad norms and replay fill/age
+  computed INSIDE the dispatched programs and drained with the deferred
+  metric drain (zero new host syncs), landing as ``learn_signal`` events
+  + tagged gauges.
+- :class:`MetricsEndpoint` (:mod:`~gsc_tpu.obs.endpoint`) — live
+  ``/metrics`` HTTP endpoint (stdlib, Prometheus text exposition) over
+  the hub snapshot, so long runs are scrapeable while they execute.
+- :mod:`~gsc_tpu.obs.curves` — per-run learning-curve extraction:
+  events.jsonl -> schema-versioned ``curves.json`` whose summary metrics
+  (final-window return, AUC, episodes-to-threshold)
+  ``tools/bench_diff.py`` gates under tolerance bands.
 - :class:`RunObserver` — the facade the trainer/CLI wire through.  It
   also owns a per-run retrace sentinel
   (:class:`gsc_tpu.analysis.sentinels.CompileMonitor`): jit traces / XLA
@@ -39,8 +52,11 @@ pipelines honest; this package is that substrate:
 
 All later perf PRs report through this subsystem.
 """
+from .curves import CURVES_SCHEMA_VERSION, extract_curves, write_curves
 from .device import device_memory_snapshot, record_device_gauges
+from .endpoint import MetricsEndpoint, prometheus_text
 from .hub import MetricsHub
+from .learning import LearnLedger, LearnLedgerSpec, emit_learn_signal
 from .perf import PERF_SCHEMA_VERSION, CostLedger
 from .run import RunObserver
 from .sinks import JsonlSink, ListSink, rotated_paths, write_atomic_json
@@ -50,5 +66,7 @@ __all__ = [
     "MetricsHub", "JsonlSink", "ListSink", "write_atomic_json",
     "rotated_paths", "device_memory_snapshot", "record_device_gauges",
     "PipelineWatchdog", "RunObserver", "CostLedger",
-    "PERF_SCHEMA_VERSION",
+    "PERF_SCHEMA_VERSION", "LearnLedger", "LearnLedgerSpec",
+    "emit_learn_signal", "MetricsEndpoint", "prometheus_text",
+    "CURVES_SCHEMA_VERSION", "extract_curves", "write_curves",
 ]

@@ -1,0 +1,104 @@
+"""Live /metrics endpoint — a stdlib-only HTTP server over the MetricsHub.
+
+Long runs used to be observable only post-hoc (events.jsonl archaeology)
+or by polling the atomic ``metrics.json`` snapshot off disk.  This module
+exposes the SAME hub snapshot over HTTP while the run executes, in
+Prometheus text exposition format, so a 100+-episode exhibit can be
+scraped/watched live (``curl`` or a real Prometheus scraper — the flat
+series names ``gsc_<name>{tag="v",...}`` are already exposition-shaped).
+
+Deliberately jax-free and read-only: the handler thread only ever calls
+``hub.snapshot()`` (one lock acquisition, O(series)), never touches the
+training loop, and serves on a daemon thread — a wedged scraper cannot
+stall a dispatch.  Wired via ``RunObserver(metrics_port=...)`` /
+``cli train --metrics-port`` (default off); ``cli serve`` reuses it for
+the serving hub.
+
+Routes: ``/metrics`` (Prometheus text), ``/healthz`` (JSON liveness).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+# the exposition version Prometheus scrapers negotiate on
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def prometheus_text(snapshot: Dict[str, float]) -> str:
+    """Hub snapshot -> Prometheus text exposition (one series per line;
+    names from ``hub.flat_name`` are already ``name{label="v"}``)."""
+    lines = []
+    for name, value in sorted(snapshot.items()):
+        try:
+            lines.append(f"{name} {float(value)}")
+        except (TypeError, ValueError):
+            continue
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one hub read per request; the server object carries the hub ref
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path in ("/metrics", "/"):
+            body = prometheus_text(self.server.hub.snapshot()).encode()
+            self._reply(200, PROM_CONTENT_TYPE, body)
+        elif path == "/healthz":
+            body = json.dumps({"status": "ok",
+                               "series": len(self.server.hub.snapshot()),
+                               }).encode()
+            self._reply(200, "application/json", body)
+        else:
+            self._reply(404, "text/plain",
+                        b"not found (routes: /metrics, /healthz)\n")
+
+    def _reply(self, code: int, ctype: str, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):   # scrapes must not spam the run log
+        pass
+
+
+class MetricsEndpoint:
+    """Background HTTP server exposing one hub.  ``port=0`` binds an
+    ephemeral port (tests; the bound port is read back from ``.port``
+    after :meth:`start`)."""
+
+    def __init__(self, hub, port: int = 0, host: str = "127.0.0.1"):
+        self.hub = hub
+        self.host = host
+        self.port = int(port)
+        self._server = None
+        self._thread = None
+
+    def start(self) -> "MetricsEndpoint":
+        server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        server.daemon_threads = True
+        server.hub = self.hub
+        self.port = server.server_address[1]
+        self._server = server
+        self._thread = threading.Thread(target=server.serve_forever,
+                                        name="gsc-metrics-endpoint",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self):
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -8,6 +8,7 @@ the training loop stays readable.
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Dict, List, Optional
 
@@ -15,6 +16,8 @@ from .device import record_device_gauges
 from .hub import MetricsHub
 from .sinks import JsonlSink, write_atomic_json
 from .watchdog import PipelineWatchdog
+
+log = logging.getLogger("gsc_tpu.obs.run")
 
 # phases whose per-episode wall deltas are worth percentile tracking
 _PHASE_HIST = ("host_sample", "host_sample_wait", "dispatch", "drain")
@@ -31,7 +34,9 @@ class RunObserver:
                  compile_events: bool = True,
                  watchdog_escalate: int = 0,
                  rotate_mb: float = 0.0,
-                 perf: bool = False):
+                 perf: bool = False,
+                 learn: bool = False,
+                 metrics_port: Optional[int] = None):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
@@ -39,6 +44,7 @@ class RunObserver:
         self.events_path = os.path.join(self.out_dir, "events.jsonl")
         self.snapshot_path = os.path.join(self.out_dir, "metrics.json")
         self.perf_path = os.path.join(self.out_dir, "perf.json")
+        self.curves_path = os.path.join(self.out_dir, "curves.json")
         # size-based rotation for 100+-episode exhibits (``--obs-rotate-mb``)
         # — readers walk the rotated segments via sinks.rotated_paths
         self.hub.add_sink(JsonlSink(self.events_path, rotate_mb=rotate_mb))
@@ -51,6 +57,19 @@ class RunObserver:
         if perf:
             from .perf import CostLedger
             self.perf = CostLedger(hub=self.hub)
+        # learning-signal ledger (obs.learning.LearnLedger): opt-in like
+        # the cost ledger — the trainer reads the facade's static spec
+        # into the jitted agents, drains per-episode signals through it,
+        # and close() extracts curves.json from the event stream.  Bare
+        # test observers stay ledger-free (historic traces untouched).
+        self.learn = None
+        if learn:
+            from .learning import LearnLedger
+            self.learn = LearnLedger(hub=self.hub)
+        # live /metrics endpoint (obs.endpoint.MetricsEndpoint): None =
+        # off; 0 = ephemeral port (tests); bound lazily in start()
+        self._metrics_port = metrics_port
+        self.endpoint = None
         self.snapshot_interval = max(int(snapshot_interval), 1)
         self.watchdog: Optional[PipelineWatchdog] = None
         if watchdog_budget_s and watchdog_budget_s > 0:
@@ -89,6 +108,20 @@ class RunObserver:
             self.compile_monitor = CompileMonitor(hub=self.hub).start()
         if self.watchdog is not None:
             self.watchdog.start()
+        if self._metrics_port is not None:
+            # best effort: a taken port must not kill a training run —
+            # the run keeps its on-disk snapshots either way
+            from .endpoint import MetricsEndpoint
+            try:
+                self.endpoint = MetricsEndpoint(
+                    self.hub, port=self._metrics_port).start()
+                self.hub.event("metrics_endpoint",
+                               port=self.endpoint.port,
+                               url=self.endpoint.url)
+            except OSError as e:
+                log.warning("metrics endpoint not started on port %s: %s",
+                            self._metrics_port, e)
+                self.endpoint = None
         return self
 
     def close(self, status: str = "ok"):
@@ -100,7 +133,23 @@ class RunObserver:
             self.watchdog.stop()
         if self.compile_monitor is not None:
             self.compile_monitor.stop()
+        if self.endpoint is not None:
+            self.endpoint.stop()
+            self.endpoint = None
         try:
+            if self.learn is not None:
+                # learning-curve extraction from the run's own event
+                # stream (rotation-aware) into schema-versioned
+                # curves.json — best effort, like the perf ledger
+                try:
+                    from .curves import write_curves
+                    from .trace import read_events
+                    events = read_events(self.events_path)
+                    if any(e.get("event") in ("episode", "harness_episode")
+                           for e in events):
+                        write_curves(self.curves_path, events)
+                except Exception:
+                    pass
             if self.perf is not None and self.perf.summary()["entries"]:
                 # the per-run cost ledger lands next to metrics.json —
                 # best effort, a cost-model failure must not mask the

@@ -86,10 +86,47 @@ TRACE_TRACKS = {
 _TRACE_PHASES = ("host_sample", "host_sample_wait", "dispatch", "drain")
 
 
+def _event_ts(e) -> float:
+    ts = e.get("ts") if isinstance(e, dict) else None
+    return float(ts) if isinstance(ts, (int, float)) \
+        and not isinstance(ts, bool) else float("-inf")
+
+
+def sort_events(events: List[Dict]) -> List[Dict]:
+    """Stable ts-sort WITHIN each run's slice of an (append-mode) stream.
+    Runs are delimited by ``run_start`` in file order — a later run whose
+    wall clock stepped backwards (NTP, VM resume) must never interleave
+    into the previous run's tail, so the sort is per-run, not global.
+    Within one run the reorder window is the emit race (ts stamped
+    before the sink lock), which is same-run by construction."""
+    out: List[Dict] = []
+    seg: List[Dict] = []
+    for e in events:
+        if isinstance(e, dict) and e.get("event") == "run_start" and seg:
+            seg.sort(key=_event_ts)
+            out.extend(seg)
+            seg = []
+        seg.append(e)
+    seg.sort(key=_event_ts)
+    out.extend(seg)
+    return out
+
+
 def read_events(path: str) -> List[Dict]:
     """Load a run's event stream: accepts the run dir or the events.jsonl
     itself, walks rotated segments (``events.jsonl.N .. .1`` then the
-    live file — the ``--obs-rotate-mb`` layout), skips torn tail lines."""
+    live file — the ``--obs-rotate-mb`` layout), skips torn tail lines.
+
+    Events come back SORTED by ``ts`` within each run (stable — same-ts
+    records keep file order; see :func:`sort_events`): the hub stamps
+    ``ts`` before taking the sink lock, so concurrently-emitting threads
+    (watchdog, prefetcher, main loop) can land out of order in the file,
+    and a rotation can split an interleaving across segments.  Every
+    consumer of this reader (trace builder, curves extraction) assumes
+    one monotone stream per run — sorting here is what makes that
+    assumption true, and keeping it per-run means appended runs never
+    interleave even when the wall clock stepped backwards between
+    them."""
     from .sinks import rotated_paths
 
     if os.path.isdir(path):
@@ -108,7 +145,7 @@ def read_events(path: str) -> List[Dict]:
                     events.append(json.loads(line))
                 except json.JSONDecodeError:
                     continue   # torn final line of a live segment
-    return events
+    return sort_events(events)
 
 
 def _us(ts: float, t0: float) -> float:
@@ -129,13 +166,16 @@ def build_trace(events: List[Dict]) -> Dict:
     events = [e for e in events if isinstance(e, dict) and "ts" in e]
     if not events:
         return {"traceEvents": [], "displayTimeUnit": "ms"}
-    # hub.event stamps ts before the sink lock, so concurrently-emitting
-    # threads (watchdog, prefetcher, main loop) can land out of order in
-    # the file; process in timestamp order or a recovery ladder's flow
-    # arrow could point backwards and fail the strict validator.  Stable
-    # sort: same-ts events keep file order.
-    events = sorted(events, key=lambda e: float(e["ts"]))
-    t0 = float(events[0]["ts"])
+    # read_events already sorts, but the builder also accepts raw lists
+    # (tests, in-memory sinks) — re-apply the SAME per-run sort so a
+    # later run whose clock stepped backwards is never woven into the
+    # previous run's slices here either.  (The trace is one timeline, so
+    # the final output sort below still orders such streams globally —
+    # a Chrome-format requirement; multi-run streams with non-monotone
+    # clocks render best-effort.)  Stable: same-ts events keep caller
+    # order.
+    events = sort_events(events)
+    t0 = min(float(e["ts"]) for e in events)
     run = next((e.get("run") for e in events if e.get("run")), "run")
     out: List[Dict] = []
 

@@ -43,7 +43,7 @@ class ParallelDDPG:
                  num_replicas: int, gnn_impl: str = None,
                  per_replica_topology: bool = False,
                  sample_mode: str = "across", donate: bool = False,
-                 plan=None):
+                 plan=None, learn_ledger=None):
         if sample_mode not in ("across", "local"):
             raise ValueError(f"unknown sample_mode {sample_mode!r}")
         self.env = env
@@ -65,8 +65,11 @@ class ParallelDDPG:
                 f"{plan.describe()}) for an even replica sharding")
         # the inner DDPG inherits ``donate`` so init() breaks the
         # target-params/params buffer aliasing that donation of the learner
-        # state would otherwise trip over (double donation)
-        self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl, donate=donate)
+        # state would otherwise trip over (double donation), and the
+        # learn-ledger spec so the shared _learn_burst folds the
+        # per-topology TD segments into the replica dispatch too
+        self.ddpg = DDPG(env, agent, gnn_impl=gnn_impl, donate=donate,
+                         learn_ledger=learn_ledger)
         # ``donate=True`` aliases the replay shards into the rollout call,
         # so XLA appends transitions to the multi-GB replay in place
         # instead of copying it every chunk call, and the learner state
@@ -359,6 +362,11 @@ class ParallelDDPG:
             # shared _learn_burst
             "state_finite": all_finite(state),
         }
+        if self.ddpg.learn_ledger is not None:
+            # per-replica replay fill/age ([B] leaves), on device — same
+            # ledger contract as the single-agent rollout
+            from ..obs.learning import replay_stats
+            episode_stats["replay"] = replay_stats(buffers)
         return (state.replace(rng=rng), buffers, env_states, obs,
                 episode_stats)
 

@@ -32,7 +32,8 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                          on_episode: Optional[Callable] = None,
                          step_offset: int = 0,
                          hub=None, timer=None,
-                         topo_names: Optional[list] = None
+                         topo_names: Optional[list] = None,
+                         learn_names: Optional[list] = None
                          ) -> Tuple[object, object, list, list, list]:
     """Train for ``episodes`` full episodes; returns (state, buffers,
     per-episode returns, per-episode MEAN success ratios, per-episode
@@ -57,7 +58,14 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
     ``topology=<name>``, mean over that topology's replicas) and the
     ``harness_episode`` event carries the per-replica ``topology`` list +
     a ``per_topology_return`` dict — a mixture member that collapses is
-    visible by name, not just as one cold row in the replica vector."""
+    visible by name, not just as one cold row in the replica vector.
+
+    ``learn_names`` (topo_id -> name, from the driver): when the agent
+    was built with a learn ledger (obs.learning), each episode's drained
+    ``learn_signal`` — per-topology |TD| segments, Q moments, layer
+    norms, replay fill — is emitted through the hub with these names;
+    ledger-free agents produce no signal and nothing is emitted."""
+    from ..obs.learning import emit_learn_signal
     from ..obs.trace import phase_span
 
     assert episode_steps % chunk == 0, (episode_steps, chunk)
@@ -142,6 +150,16 @@ def run_chunked_episodes(pddpg, topo, episode_traffic: Callable,
                       **({"topology": list(topo_names),
                           "per_topology_return": per_topo}
                          if topo_names else {}))
+            signal = (metrics or {}).get("learn_signal") \
+                if isinstance(metrics, dict) else None
+            replay = chunk_stats[-1].get("replay") \
+                if isinstance(chunk_stats[-1], dict) else None
+            if signal is not None or replay is not None:
+                # everything here was synced by the drain above — the
+                # emit is pure host bookkeeping, never a device wait
+                emit_learn_signal(hub, global_ep, signal=signal,
+                                  replay=replay,
+                                  segment_names=learn_names)
         if on_episode is not None:
             on_episode(ep, returns[-1], succ[-1], metrics)
     return state, buffers, returns, succ, final_succ

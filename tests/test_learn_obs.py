@@ -1,0 +1,527 @@
+"""Training-quality observability tests (on-device learn ledger, live
+/metrics endpoint, learning-curve envelope comparator) — the PR-11 layer.
+
+Covers: ledger arithmetic vs a hand-computed tiny batch, per-topology
+TD-error segmentation on a mixed [A, B, A, B] batch, ledger-on vs
+ledger-off bit-identity of the training math, the no-host-sync dispatch
+contract, the /metrics endpoint scrape roundtrip, curves.json end-to-end
+from a tiny run (with the serial path's topology stamping), bench_diff
+curve-ingest + envelope-regression verdicts, and the shuffled-write
+read_events sort (the hub stamps ts before the sink lock, so concurrent
+threads can land out of order in the file).
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.agents.buffer import buffer_add, buffer_init
+from gsc_tpu.agents.ddpg import DDPG
+from gsc_tpu.agents.trainer import Trainer
+from gsc_tpu.obs import (CURVES_SCHEMA_VERSION, JsonlSink, ListSink,
+                         MetricsEndpoint, MetricsHub, RunObserver,
+                         extract_curves, prometheus_text)
+from gsc_tpu.obs.learning import (LearnLedger, LearnLedgerSpec,
+                                  accumulate_signal, layer_norms,
+                                  learn_signal, replay_stats,
+                                  zero_learn_signal)
+from gsc_tpu.obs.trace import build_trace, read_events, validate_trace
+
+from tests.test_agent import make_driver, make_stack
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import bench_diff
+import obs_report
+
+pytestmark = pytest.mark.learn_obs
+
+
+# ------------------------------------------------------- ledger arithmetic
+def test_learn_signal_arithmetic_hand_computed():
+    """Ledger pieces vs a hand-computed tiny batch: per-topology |TD|
+    segment sums, Q distribution moments, per-layer norms."""
+    spec = LearnLedgerSpec(num_topos=3)
+    topo_idx = jnp.asarray([0, 1, 0, 2, 7], jnp.int32)   # 7 clips to 2
+    td = jnp.asarray([1.0, -2.0, 3.0, -4.0, 0.5])
+    q = jnp.asarray([0.5, 1.5, 2.5, 3.5, 4.5])
+    params = {"actor": {"params": {"Dense_0": {
+                  "kernel": jnp.asarray([[3.0, 4.0]]),
+                  "bias": jnp.zeros(2)}}},
+              "critic": {"params": {"Dense_0": {
+                  "kernel": jnp.asarray([[5.0, 12.0]])}}}}
+    grads = jax.tree_util.tree_map(lambda x: 2.0 * x, params)
+    sig = learn_signal(spec, topo_idx, td, q, params=params, grads=grads)
+
+    np.testing.assert_allclose(np.asarray(sig["td_abs_sum"]),
+                               [4.0, 2.0, 4.5])
+    np.testing.assert_allclose(np.asarray(sig["td_count"]),
+                               [2.0, 1.0, 2.0])
+    np.testing.assert_allclose(float(sig["q_mean"]), np.mean(np.asarray(q)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(sig["q_std"]), np.std(np.asarray(q)),
+                               rtol=1e-6)
+    assert float(sig["q_min"]) == 0.5 and float(sig["q_max"]) == 4.5
+    # per-layer norms group by <tree>/<module> and drop 'params' levels
+    assert set(sig["param_norms"]) == {"actor/Dense_0", "critic/Dense_0"}
+    np.testing.assert_allclose(float(sig["param_norms"]["actor/Dense_0"]),
+                               5.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sig["param_norms"]["critic/Dense_0"]),
+                               13.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sig["grad_norms"]["actor/Dense_0"]),
+                               10.0, rtol=1e-6)
+
+    # accumulation: TD segments sum, moments take the newest value
+    state_like = type("S", (), {"actor_params": params["actor"],
+                                "critic_params": params["critic"]})
+    zero = zero_learn_signal(spec, state_like)
+    assert jax.tree_util.tree_structure(zero) \
+        == jax.tree_util.tree_structure(sig)
+    acc = accumulate_signal(accumulate_signal(zero, sig), sig)
+    np.testing.assert_allclose(np.asarray(acc["td_abs_sum"]),
+                               [8.0, 4.0, 9.0])
+    assert float(acc["q_max"]) == 4.5
+
+    # layer_norms standalone agrees with the signal's view
+    np.testing.assert_allclose(
+        float(layer_norms(params)["critic/Dense_0"]), 13.0, rtol=1e-6)
+
+
+def test_replay_stats_both_layouts():
+    example = {"x": jnp.zeros(3)}
+    buf = buffer_init(example, capacity=8)
+    for i in range(3):
+        buf = buffer_add(buf, {"x": jnp.full(3, i, jnp.float32)})
+    stats = replay_stats(buf)
+    assert int(stats["size"]) == 3
+    np.testing.assert_allclose(float(stats["fill"]), 3 / 8)
+    np.testing.assert_allclose(float(stats["age_mean_steps"]), 1.0)
+
+    # replica-sharded layout: [B, capacity, ...] leaves, size [B]
+    from gsc_tpu.agents.buffer import ReplayBuffer
+    pbuf = ReplayBuffer(data={"x": jnp.zeros((2, 4, 3))},
+                        pos=jnp.zeros(2, jnp.int32),
+                        size=jnp.asarray([4, 1], jnp.int32), shapes=None)
+    pstats = replay_stats(pbuf)
+    np.testing.assert_allclose(np.asarray(pstats["fill"]), [1.0, 0.25])
+    np.testing.assert_allclose(np.asarray(pstats["age_mean_steps"]),
+                               [1.5, 0.0])
+
+
+# ------------------------------------------------- dispatch-path contracts
+def _episode_inputs(env, topo, traffic, ddpg, seed=0):
+    env_state, obs = env.reset(jax.random.PRNGKey(seed), topo, traffic)
+    state = ddpg.init(jax.random.PRNGKey(1), obs)
+    buffer = ddpg.init_buffer(obs)
+    return state, buffer, env_state, obs
+
+
+def test_ledger_on_is_bit_identical_and_emits_signal():
+    """The acceptance contract's numeric half: the ledger only CONSUMES
+    tensors the update path materialized, so a ledger-on run's learner
+    state and replay are BIT-identical to the ledger-off (pre-PR) run —
+    while its metrics additionally carry the learn signal."""
+    env, agent, topo, traffic = make_stack()
+    plain = DDPG(env, agent)
+    led = DDPG(env, agent, learn_ledger=LearnLedgerSpec(num_topos=2))
+
+    outs = {}
+    for name, ddpg in (("plain", plain), ("ledger", led)):
+        state, buffer, env_state, obs = _episode_inputs(env, topo, traffic,
+                                                        ddpg)
+        for ep in range(2):
+            state, buffer, env_state, obs, stats, metrics = \
+                ddpg.episode_step(state, buffer, env_state, obs, topo,
+                                  traffic,
+                                  np.int32(ep * agent.episode_steps),
+                                  learn=True)
+        outs[name] = (state, buffer, stats, metrics)
+
+    s_p, b_p, st_p, m_p = outs["plain"]
+    s_l, b_l, st_l, m_l = outs["ledger"]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        (s_p, b_p.data), (s_l, b_l.data))
+    assert "learn_signal" not in m_p and "replay" not in st_p
+    sig = m_l["learn_signal"]
+    # every burst sample lands in exactly one TD segment
+    n_steps = agent.learn_steps or agent.episode_steps
+    assert float(np.asarray(sig["td_count"]).sum()) \
+        == n_steps * agent.batch_size
+    assert np.isfinite(np.asarray(sig["td_abs_sum"])).all()
+    assert set(sig["grad_norms"]) == set(sig["param_norms"])
+    assert float(st_l["replay"]["size"]) == int(b_l.size)
+
+
+def test_ledger_dispatch_is_host_sync_free():
+    """The acceptance contract's sync half: with the ledger folded into
+    the dispatch outputs, the fused episode dispatch performs ZERO
+    device->host syncs — the signal drains with the deferred metrics."""
+    from gsc_tpu.analysis.sentinels import no_host_sync
+
+    env, agent, topo, traffic = make_stack()
+    ddpg = DDPG(env, agent, learn_ledger=LearnLedgerSpec(num_topos=1))
+    state, buffer, env_state, obs = _episode_inputs(env, topo, traffic,
+                                                    ddpg)
+    # warm the trace outside the guard (compile-time work is not dispatch)
+    out = ddpg.episode_step(state, buffer, env_state, obs, topo, traffic,
+                            np.int32(0), learn=True)
+    jax.block_until_ready(out)
+    state, buffer, env_state, obs = out[:4]
+    with no_host_sync("learn-ledger dispatch"):
+        out = ddpg.episode_step(state, buffer, env_state, obs, topo,
+                                traffic, np.int32(agent.episode_steps),
+                                learn=True)
+    # the deferred drain's sync happens OUTSIDE the guard
+    assert np.isfinite(np.asarray(out[4]["episodic_return"]))
+    assert np.isfinite(np.asarray(out[5]["learn_signal"]["td_abs_sum"])).all()
+
+
+def test_mixed_batch_td_segments_by_topology():
+    """[A, B, A, B] mixed batch: the burst's TD segments attribute every
+    sampled transition to its stored topo_idx — segments 0 and 1 fill,
+    the padding segments stay exactly zero."""
+    from gsc_tpu.parallel import ParallelDDPG
+    from gsc_tpu.sim.traffic import generate_traffic
+    from gsc_tpu.topology import stack_topologies
+    from gsc_tpu.topology.compiler import compile_topology
+    from gsc_tpu.topology.synthetic import line, triangle
+
+    env, agent, _, _ = make_stack()
+    tA = compile_topology(triangle(), max_nodes=8, max_edges=8, topo_id=0)
+    tB = compile_topology(line(4), max_nodes=8, max_edges=8, topo_id=1)
+    steps = agent.episode_steps
+    tr = lambda t, s: generate_traffic(env.sim_cfg, env.service, t, steps,
+                                       seed=s, capacity=64)
+    topo = stack_topologies([tA, tB, tA, tB])
+    traffic = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[tr(t, s) for t, s in ((tA, 0), (tB, 10), (tA, 1), (tB, 11))])
+    pddpg = ParallelDDPG(env, agent, num_replicas=4,
+                         per_replica_topology=True,
+                         learn_ledger=LearnLedgerSpec(num_topos=4))
+    env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+    buffers = pddpg.init_buffers(one_obs)
+    state, buffers, env_states, obs, stats, metrics = pddpg.chunk_step(
+        state, buffers, env_states, obs, topo, traffic, jnp.int32(10 ** 6),
+        num_steps=steps, learn=True)
+    counts = np.asarray(metrics["learn_signal"]["td_count"])
+    n_steps = agent.learn_steps or agent.episode_steps
+    assert counts.sum() == n_steps * agent.batch_size
+    assert counts[0] > 0 and counts[1] > 0, counts
+    np.testing.assert_array_equal(counts[2:], 0.0)
+    # replay stats carry the per-replica [B] axis
+    assert np.asarray(stats["replay"]["fill"]).shape == (4,)
+
+
+# --------------------------------------------------------------- endpoint
+def test_metrics_endpoint_scrape_roundtrip():
+    hub = MetricsHub(tags={"run": "scrape"})
+    hub.counter("episodes_drained", 3)
+    hub.gauge("sps", 123.5)
+    hub.gauge("topology_return", -2.5, topology="abilene.graphml")
+    hub.observe("phase_s", 0.25, phase="dispatch")
+    ep = MetricsEndpoint(hub, port=0).start()
+    try:
+        assert ep.port > 0
+        body = urllib.request.urlopen(ep.url, timeout=10).read().decode()
+        parsed = {}
+        for line in body.strip().splitlines():
+            name, value = line.rsplit(" ", 1)
+            parsed[name] = float(value)
+        # the scrape IS the snapshot (same flat exposition names)
+        snap = hub.snapshot()
+        assert parsed == {k: float(v) for k, v in snap.items()}
+        assert parsed['gsc_sps{run="scrape"}'] == 123.5
+        assert parsed[
+            'gsc_topology_return{run="scrape",topology="abilene.graphml"}'
+        ] == -2.5
+        assert 'gsc_phase_s_p99{phase="dispatch",run="scrape"}' in parsed
+        # a scrape between hub writes sees the newer value (live, not a
+        # point-in-time file)
+        hub.gauge("sps", 200.0)
+        body2 = urllib.request.urlopen(ep.url, timeout=10).read().decode()
+        assert 'gsc_sps{run="scrape"} 200.0' in body2
+        health = json.loads(urllib.request.urlopen(
+            ep.url.replace("/metrics", "/healthz"), timeout=10).read())
+        assert health["status"] == "ok" and health["series"] > 0
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ep.url.replace("/metrics", "/nope"),
+                                   timeout=10)
+    finally:
+        ep.stop()
+    assert "gsc_sps" in prometheus_text(hub.snapshot())
+
+
+# ----------------------------------------------------------------- curves
+def test_extract_curves_summary_math():
+    base = 1_000_000.0
+    events = [{"event": "run_start", "ts": base, "run": "cm"}]
+    for ep in range(20):
+        events.append({"event": "episode", "ts": base + 1 + ep,
+                       "run": "cm", "episode": ep,
+                       "episodic_return": float(ep), "critic_loss": 0.5,
+                       "actor_loss": -0.5, "sps": 10.0})
+        events.append({"event": "learn_signal", "ts": base + 1.5 + ep,
+                       "run": "cm", "episode": ep,
+                       "td_abs_mean": 2.0 - 0.05 * ep, "q_mean": 0.1,
+                       "per_topology_td": {"tri": 2.0 - 0.05 * ep}})
+    doc = extract_curves(events)
+    assert doc["schema_version"] == CURVES_SCHEMA_VERSION
+    assert doc["episodes"] == 20 and doc["run"] == "cm"
+    s = doc["summary"]
+    assert s["final_window_return"] == pytest.approx(14.5)
+    assert s["first_window_return"] == pytest.approx(4.5)
+    assert s["auc_return"] == pytest.approx(9.5)
+    # threshold = 4.5 + 0.9*(14.5-4.5) = 13.5; trailing-10 mean first
+    # reaches it at episode 18 (mean of 9..18)
+    assert s["threshold_return"] == pytest.approx(13.5)
+    assert s["episodes_to_threshold"] == 18
+    assert s["final_window_td_abs"] == pytest.approx(
+        sum(2.0 - 0.05 * ep for ep in range(10, 20)) / 10)
+    assert doc["per_topology"]["tri"]["episode"] == list(range(20))
+    # non-finite values sanitize to null (strict-JSON contract)
+    events.append({"event": "episode", "ts": base + 100, "run": "cm",
+                   "episode": 20, "episodic_return": float("nan")})
+    doc2 = extract_curves(events)
+    assert doc2["series"]["episodic_return"][-1] is None
+    json.dumps(doc2)   # must be serializable
+
+    # a flat/declining run has no time-to-learn: null, never a fake 0
+    flat = [{"event": "episode", "ts": base + ep, "episode": ep,
+             "episodic_return": 5.0 - ep} for ep in range(12)]
+    assert extract_curves(flat)["summary"]["episodes_to_threshold"] is None
+
+
+def test_curves_e2e_tiny_run_and_bench_diff_gate(tmp_path):
+    """Serial tiny run under RunObserver(learn=True): learn_signal events
+    + topology-stamped episode events land in the stream, close() writes
+    curves.json, bench_diff ingests it and self-compares clean while an
+    injected envelope regression exits 1."""
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path / "obs"), run_id="learnrun", learn=True)
+    obs.start(meta={"episodes": 3})
+    trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path),
+                      obs=obs)
+    trainer.train(episodes=3)
+    obs.close()
+
+    events = read_events(str(tmp_path / "obs"))
+    signals = [e for e in events if e["event"] == "learn_signal"]
+    assert [e["episode"] for e in signals] == [0, 1, 2]
+    assert signals[-1]["per_topology_td"], "per-topology TD missing"
+    assert signals[-1]["replay"]["fill"] > 0
+    # serial-path topology identity (the satellite): every episode event
+    # carries the scheduled network's name, and the gauge exists
+    eps = [e for e in events if e["event"] == "episode"]
+    assert all(e.get("topology") == "x" for e in eps)
+    snap = json.load(open(tmp_path / "obs" / "metrics.json"))["metrics"]
+    assert any(k.startswith("gsc_topology_return") and 'topology="x"' in k
+               for k in snap)
+    assert any(k.startswith("gsc_td_abs_mean") for k in snap)
+    assert any(k.startswith("gsc_grad_norm{") for k in snap)
+
+    curves = json.load(open(tmp_path / "obs" / "curves.json"))
+    assert curves["schema_version"] == CURVES_SCHEMA_VERSION
+    assert curves["episodes"] == 3
+    assert len(curves["series"]["episodic_return"]) == 3
+    assert len(curves["series"]["td_abs_mean"]) == 3
+    assert curves["per_topology"]["x"]["episode"] == [0, 1, 2]
+    assert curves["summary"]["final_window_return"] is not None
+
+    # obs_report renders the stream's learning section
+    summary = obs_report.summarize(
+        obs_report.load_events(str(tmp_path / "obs")))
+    assert summary["learning"]["episodes"] == 3
+    assert "x" in summary["learning"]["per_topology_td"]
+    assert summary["per_topology"]["x"]["episodes"] == 3
+    obs_report.render_text(summary, out=open(os.devnull, "w"))
+
+    # bench_diff: ingest + self-compare clean + injected regression rc 1
+    traj = str(tmp_path / "traj.json")
+    doc = bench_diff.ingest([str(tmp_path / "obs" / "curves.json")], traj)
+    assert "curves_learnrun" in doc["rows"]
+    assert bench_diff.main(["diff", "curves_learnrun", "--baseline",
+                            "curves_learnrun", "--trajectory", traj]) == 0
+    base_final = doc["rows"]["curves_learnrun"]["metrics"][
+        "final_window_return"]
+    bad = dict(curves)
+    bad["summary"] = {**curves["summary"],
+                      "final_window_return": base_final
+                      - 10 * abs(base_final) - 100.0}
+    bad_path = str(tmp_path / "bad_curves.json")
+    with open(bad_path, "w") as f:
+        json.dump(bad, f)
+    assert bench_diff.main(["diff", bad_path, "--baseline",
+                            "curves_learnrun", "--trajectory", traj]) == 1
+
+
+def test_parallel_run_emits_learn_signal_and_topology(tmp_path):
+    """train_parallel (homogeneous replicas): the harness emits the
+    learn_signal per episode and the episode events stamp the topology
+    name — replica runs land in the same report tables as serial ones."""
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path / "obs"), run_id="prun", learn=True)
+    obs.start(meta={"episodes": 2})
+    trainer = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path),
+                      obs=obs)
+    trainer.train_parallel(episodes=2, num_replicas=2, chunk=2,
+                           device_traffic=False)
+    obs.close()
+    events = read_events(str(tmp_path / "obs"))
+    signals = [e for e in events if e["event"] == "learn_signal"]
+    assert [e["episode"] for e in signals] == [0, 1]
+    assert signals[-1]["per_topology_td"] == {
+        "x": signals[-1]["td_abs_mean"]}
+    assert len(signals[-1]["replay"]["size"]) == 2   # per-replica
+    eps = [e for e in events if e["event"] == "episode"]
+    assert all(e.get("topology") == "x" and e.get("replicas") == 2
+               for e in eps)
+    curves = json.load(open(tmp_path / "obs" / "curves.json"))
+    assert curves["episodes"] == 2
+    assert len(curves["series"]["td_abs_mean"]) == 2
+
+
+# ------------------------------------------------- shuffled-write reading
+def test_read_events_sorts_shuffled_writes(tmp_path):
+    """The hub stamps ts before taking the sink lock, so concurrent
+    threads can interleave out of order in the file (and across rotation
+    segments).  read_events must return one ts-sorted stream that the
+    strict trace validator accepts."""
+    path = str(tmp_path / "events.jsonl")
+    base = 1_000_000_000.0
+    records = [{"event": "run_start", "ts": base, "run": "shuf"}]
+    disp = 0.0
+    for ep in range(6):
+        disp += 0.01
+        records.append({"event": "episode", "ts": base + 1 + ep,
+                        "run": "shuf", "episode": ep, "sps": 1.0,
+                        "episodic_return": float(ep),
+                        "phases": {"dispatch": {"total_s": round(disp, 3),
+                                                "count": ep + 1,
+                                                "mean_ms": 10.0}}})
+        records.append({"event": "learn_signal", "ts": base + 1.25 + ep,
+                        "run": "shuf", "episode": ep, "td_abs_mean": 1.0})
+    records.append({"event": "run_end", "ts": base + 99, "run": "shuf",
+                    "status": "ok"})
+
+    # adversarial write order, split across two rotation segments.
+    # run_start stays FIRST in file order — it is emitted before any
+    # concurrent writer exists, and the per-run sort keys off it.
+    body = [records[i + 1] for i in
+            np.random.RandomState(7).permutation(len(records) - 1)]
+    shuffled = [records[0]] + body
+    cut = len(shuffled) // 2
+    with open(path + ".1", "w") as f:
+        for r in shuffled[:cut]:
+            f.write(json.dumps(r) + "\n")
+    with open(path, "w") as f:
+        for r in shuffled[cut:]:
+            f.write(json.dumps(r) + "\n")
+
+    events = read_events(path)
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts), "read_events did not sort by ts"
+    assert [e["episode"] for e in events if e["event"] == "episode"] \
+        == list(range(6))
+    assert validate_trace(build_trace(events)) == []
+    # the report's reader sorts identically, so phase deltas stay sane
+    assert obs_report.load_events(path) == events
+    deltas = obs_report.phase_deltas(
+        [e for e in events if e["event"] == "episode"])
+    assert all(d.get("dispatch", 0.0) >= 0.0 for d in deltas)
+    # curves extraction sees the ordered series
+    doc = extract_curves(events)
+    assert doc["series"]["episodic_return"] == [float(e) for e in range(6)]
+
+
+def test_hub_out_of_order_sink_writes_roundtrip(tmp_path):
+    """Regression for the emit race itself: records handed to the sink
+    with non-monotone ts (the stamped-before-lock interleaving) come back
+    sorted from read_events."""
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSink(path)
+    sink.emit({"event": "run_start", "ts": 100.0, "run": "r"})
+    sink.emit({"event": "stall", "ts": 103.0, "run": "r"})       # watchdog
+    sink.emit({"event": "episode", "ts": 101.0, "run": "r",      # main loop
+               "episode": 0})
+    sink.emit({"event": "episode", "ts": 102.0, "run": "r", "episode": 1})
+    sink.close()
+    kinds = [(e["ts"], e["event"]) for e in read_events(path)]
+    assert kinds == [(100.0, "run_start"), (101.0, "episode"),
+                     (102.0, "episode"), (103.0, "stall")]
+
+
+def test_read_events_sort_never_crosses_run_boundaries(tmp_path):
+    """Appended (--resume) runs whose wall clock stepped BACKWARDS (NTP,
+    VM resume) must not interleave: the sort is per run_start-delimited
+    slice, so run partitioning and last-run summaries stay correct."""
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSink(path)
+    sink.emit({"event": "run_start", "ts": 500.0, "run": "r1"})
+    sink.emit({"event": "episode", "ts": 502.0, "run": "r1", "episode": 0,
+               "episodic_return": 1.0})
+    # second run appends with an EARLIER clock
+    sink.emit({"event": "run_start", "ts": 100.0, "run": "r2"})
+    sink.emit({"event": "episode", "ts": 103.0, "run": "r2", "episode": 0,
+               "episodic_return": 2.0})
+    sink.emit({"event": "episode", "ts": 101.0, "run": "r2", "episode": 1,
+               "episodic_return": 3.0})
+    sink.close()
+    events = read_events(path)
+    # run 2's records all stay AFTER run 1's, sorted within their run
+    assert [(e["run"], e["ts"]) for e in events] == [
+        ("r1", 500.0), ("r1", 502.0),
+        ("r2", 100.0), ("r2", 101.0), ("r2", 103.0)]
+    assert obs_report.load_events(path) == events
+    # the report summarizes the LAST run only, with run 2's episodes
+    s = obs_report.summarize(events)
+    assert s["runs_in_stream"] == 2 and s["episodes"] == 2
+    # curves extraction likewise sees only run 2, keyed by episode index
+    doc = extract_curves(events)
+    assert doc["run"] == "r2"
+    assert doc["series"]["episode"] == [0, 1]
+    assert doc["series"]["episodic_return"] == [2.0, 3.0]
+
+
+def test_learn_ledger_emit_without_device(tmp_path):
+    """Host-side emitter semantics on plain numpy inputs: segment names
+    resolve, empty segments are omitted, gauges land."""
+    hub = MetricsHub(tags={"run": "emit"})
+    sink = ListSink()
+    hub.add_sink(sink)
+    led = LearnLedger(hub)
+    spec = led.spec(3, names=["tri", "line", "ring"])
+    assert spec == LearnLedgerSpec(num_topos=3)
+    led.episode(5, signal={
+        "td_abs_sum": np.asarray([4.0, 0.0, 1.0]),
+        "td_count": np.asarray([2.0, 0.0, 4.0]),
+        "q_mean": np.float32(0.5), "q_std": np.float32(0.1),
+        "q_min": np.float32(0.0), "q_max": np.float32(1.0),
+        "grad_norms": {"actor/MLP_0": np.float32(2.0)},
+        "param_norms": {"actor/MLP_0": np.float32(3.0)},
+    }, replay={"size": np.asarray([7]), "fill": np.asarray([0.5]),
+               "age_mean_steps": np.asarray([3.0])})
+    (ev,) = sink.of_kind("learn_signal")
+    assert ev["episode"] == 5
+    # 'line' has no samples this burst: omitted, never a fake 0.0
+    assert ev["per_topology_td"] == {"tri": 2.0, "ring": 0.25}
+    assert ev["td_abs_mean"] == pytest.approx(5.0 / 6.0, abs=1e-6)
+    assert ev["replay"] == {"size": [7], "fill": 0.5,
+                            "age_mean_steps": 3.0}
+    assert hub.get_gauge("td_abs_mean", topology="tri") == 2.0
+    assert hub.get_gauge("td_abs_mean", topology="line") is None
+    assert hub.get_gauge("grad_norm", layer="actor/MLP_0") == 2.0
+    assert hub.get_gauge("replay_fill") == 0.5

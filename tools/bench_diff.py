@@ -3,7 +3,10 @@
 The repo accumulates a perf trajectory nobody reads mechanically:
 ``BENCH_r0*.json`` (env-steps/s ladder rounds), ``MULTICHIP_r0*.json``
 (mesh-carving bit-equality matrices), ``SERVE_r0*.json`` (latency-SLA
-legs) and now the per-run ``perf.json`` cost ledgers (gsc_tpu.obs.perf).
+legs), the per-run ``perf.json`` cost ledgers (gsc_tpu.obs.perf) and the
+per-run ``curves.json`` learning-curve envelopes (gsc_tpu.obs.curves:
+final-window return, AUC, episodes-to-threshold — the banded quality
+envelope ROADMAP item 2 trades bit-exactness against).
 This tool makes that trajectory a guarded artifact:
 
 - **ingest**: normalize any mix of those files into rows of one
@@ -47,11 +50,16 @@ from typing import Dict, List, Optional, Tuple
 TRAJECTORY_SCHEMA_VERSION = 1
 
 # metric gating rules, matched by key SUFFIX (first match wins):
-# (suffix, higher_is_better, relative tolerance band).  A metric with no
-# matching rule is carried in the rows but never gated — flops/bytes
-# legitimately move when the model changes; rates/latencies/fusion
-# counts are the contract.
-METRIC_RULES: List[Tuple[str, bool, float]] = [
+# (suffix, higher_is_better, relative tolerance band[, absolute band
+# floor]).  A metric with no matching rule is carried in the rows but
+# never gated — flops/bytes legitimately move when the model changes;
+# rates/latencies/fusion counts are the contract.  The absolute floor
+# exists for metrics that legitimately sit at or cross ZERO (episode
+# returns): band = max(tol * |baseline|, floor), so a baseline of ~0
+# never shrinks the band to nothing and flags pure noise as regression
+# (the strictly-positive perf metrics keep the historic relative-only
+# band — an explicit floor of 0.0).
+METRIC_RULES: List[Tuple] = [
     ("env_steps_per_sec", True, 0.10),
     ("vs_baseline", True, 0.10),
     ("rps", True, 0.15),
@@ -65,20 +73,33 @@ METRIC_RULES: List[Tuple[str, bool, float]] = [
     ("bit_equal", True, 0.0),
     ("cold_start_s", False, 0.25),
     ("cache_hit_start_s", False, 0.25),
+    # learning-curve envelope metrics (per-run curves.json summaries,
+    # gsc_tpu.obs.curves) — the quality_anchor trade currency ROADMAP
+    # item 2 names: a tensor-parallel rulebook is acceptable when these
+    # stay inside the bands, not only when results are bit-identical.
+    # Returns legitimately cross zero, so they carry absolute floors
+    # (episode-return units / episodes / |TD| units respectively).
+    ("final_window_return", True, 0.20, 1.0),
+    ("auc_return", True, 0.25, 1.0),
+    ("episodes_to_threshold", False, 0.25, 1.0),
+    ("final_window_td_abs", False, 0.30, 0.05),
 ]
 
-# filename patterns `ingest --scan` picks up.  perf.json ledgers are
-# searched RECURSIVELY: runs write them at results/<id>/<timestamp>/
-# (utils.experiment.setup_result_dir layout), arbitrarily deep below
-# the scan root.
+# filename patterns `ingest --scan` picks up.  perf.json ledgers and
+# curves.json learning curves are searched RECURSIVELY: runs write them
+# at results/<id>/<timestamp>/ (utils.experiment.setup_result_dir
+# layout), arbitrarily deep below the scan root.
 SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
-                 "MIXTOPO_r*.json", "**/perf.json")
+                 "MIXTOPO_r*.json", "**/perf.json", "**/curves.json")
 
 
-def metric_rule(name: str) -> Optional[Tuple[bool, float]]:
-    for suffix, higher, tol in METRIC_RULES:
+def metric_rule(name: str) -> Optional[Tuple[bool, float, float]]:
+    """(higher_is_better, relative tolerance, absolute band floor) for a
+    gated metric; None = informational."""
+    for rule in METRIC_RULES:
+        suffix, higher, tol = rule[:3]
         if name.endswith(suffix):
-            return higher, tol
+            return higher, tol, (rule[3] if len(rule) > 3 else 0.0)
     return None
 
 
@@ -170,6 +191,25 @@ def _perf_row(d: Dict) -> Dict:
                         "ledger_schema": d.get("schema_version")}}
 
 
+def _curves_row(d: Dict) -> Dict:
+    """A gsc_tpu.obs.curves learning-curve document (curves.json).  The
+    summary's envelope metrics gate; ``episodes_to_threshold`` is often
+    null (a run that never rose has no time-to-learn) and is then simply
+    absent — the diff reports it as ``missing``, never a regression."""
+    summary = d.get("summary") or {}
+    metrics: Dict[str, float] = {}
+    for k in ("final_window_return", "auc_return", "episodes_to_threshold",
+              "final_window_td_abs", "first_window_return"):
+        if _num(summary.get(k)) is not None:
+            metrics[k] = float(summary[k])
+    if _num(d.get("episodes")) is not None:
+        metrics["episodes"] = float(d["episodes"])
+    return {"kind": "curves", "status": "ok", "metrics": metrics,
+            "context": {"run": d.get("run"),
+                        "curves_schema": d.get("schema_version"),
+                        "window": summary.get("window")}}
+
+
 def extract_row(path: str) -> Optional[Dict]:
     """Classify + normalize one artifact file; None if unrecognized."""
     try:
@@ -198,15 +238,17 @@ def extract_row(path: str) -> Optional[Dict]:
         row = _multichip_row(d)
     elif "schema_version" in d and "entries" in d:
         row = _perf_row(d)
+    elif "schema_version" in d and "series" in d and "summary" in d:
+        row = _curves_row(d)
     else:
         return None
     base = os.path.basename(path)
     name = os.path.splitext(base)[0]
-    if name == "perf":
-        # per-run ledgers all share the filename; key by run dir (or the
-        # ledger's recorded run id) so two runs never collide
+    if name in ("perf", "curves"):
+        # per-run artifacts share their filename; key by run dir (or the
+        # document's recorded run id) so two runs never collide
         run = (row.get("context") or {}).get("run")
-        name = f"perf_{run or os.path.basename(os.path.dirname(os.path.abspath(path)))}"
+        name = f"{name}_{run or os.path.basename(os.path.dirname(os.path.abspath(path)))}"
     row.update(name=name, source=path)
     return row
 
@@ -306,10 +348,13 @@ def diff_rows(current: Dict, baseline: Dict,
         if rule is None:
             rec["verdict"] = "informational"
         else:
-            higher, tol = rule
+            higher, tol, floor = rule
             tol = tolerances.get(name, tol)
             delta = (cur - base) if higher else (base - cur)   # + is good
-            band = tol * abs(base)
+            # the floor keeps a near-zero baseline (returns oscillating
+            # around 0) from shrinking the band to nothing and gating
+            # on noise; 0.0 for the strictly-positive perf metrics
+            band = max(tol * abs(base), floor)
             if delta < -band - 1e-12:
                 rec["verdict"] = "regression"
                 rec["tolerance"] = tol
@@ -374,13 +419,23 @@ def selftest() -> int:
             "entries": {"episode_step": {
                 "available": True, "flops": 6.6e6, "bytes_accessed": 6.7e6,
                 "fusions": 718, "mfu": 1e-4, "wall_s_mean": 1.3}}})
+        curves = dump("curves.json", {
+            "schema_version": 1, "run": "curveself", "episodes": 12,
+            "series": {"episode": list(range(12))}, "per_topology": {},
+            "summary": {"window": 10, "final_window_return": 20.0,
+                        "first_window_return": -10.0, "auc_return": 5.0,
+                        "episodes_to_threshold": 8,
+                        "final_window_td_abs": 0.4}})
         traj = os.path.join(tmp, "BENCH_TRAJECTORY.json")
-        doc = ingest([good, slow, wrapper, perf], traj)
+        doc = ingest([good, slow, wrapper, perf, curves], traj)
         assert set(doc["rows"]) == {"BENCH_r98", "BENCH_r99", "BENCH_r97",
-                                    "perf_selftest"}, doc["rows"].keys()
+                                    "perf_selftest", "curves_curveself"}, \
+            doc["rows"].keys()
         assert doc["rows"]["BENCH_r97"]["status"] == "failed"
         assert doc["rows"]["perf_selftest"]["metrics"][
             "episode_step_fusions"] == 718.0
+        assert doc["rows"]["curves_curveself"]["metrics"][
+            "final_window_return"] == 20.0
 
         # per-run ledgers live at results/<id>/<timestamp>/perf.json —
         # `--scan` must find them recursively
@@ -417,6 +472,38 @@ def selftest() -> int:
                       {**doc["rows"]["BENCH_r99"], "name": "BENCH_r99"})
         assert d["verdict"] == "ok" \
             and d["metrics"]["env_steps_per_sec"]["verdict"] == "improved"
+
+        # learning-curve envelope: a run that learns less (lower final-
+        # window return / AUC, slower to threshold, more residual TD)
+        # regresses on every curve axis; self-compare stays clean
+        crow = {**doc["rows"]["curves_curveself"], "name": "cur"}
+        d = diff_rows(crow, {**doc["rows"]["curves_curveself"],
+                             "name": "base"})
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        worse = {"name": "worse", "status": "ok", "kind": "curves",
+                 "metrics": {"final_window_return": 10.0, "auc_return": 3.0,
+                             "episodes_to_threshold": 11.0,
+                             "final_window_td_abs": 0.6, "episodes": 12.0}}
+        d = diff_rows(worse, crow)
+        assert d["verdict"] == "regression", d
+        for m in ("final_window_return", "auc_return",
+                  "episodes_to_threshold", "final_window_td_abs"):
+            assert m in d["regressions"], (m, d["regressions"])
+        # `episodes` carries no rule — run length is context, not a gate
+        assert d["metrics"]["episodes"]["verdict"] == "informational", d
+        # absolute band floor: returns oscillating around zero must not
+        # gate on noise (relative band alone would be ~0.002 here)
+        d = diff_rows({"name": "n1",
+                       "metrics": {"final_window_return": -0.01}},
+                      {"name": "n0",
+                       "metrics": {"final_window_return": 0.01}})
+        assert d["verdict"] == "ok", d
+        # ...while a real collapse past the floor still flags
+        d = diff_rows({"name": "n2",
+                       "metrics": {"final_window_return": -2.5}},
+                      {"name": "n0",
+                       "metrics": {"final_window_return": 0.01}})
+        assert d["verdict"] == "regression", d
 
         # a widened tolerance declassifies a small regression
         d = diff_rows({"name": "a", "metrics": {"x_mfu": 0.9}},
@@ -474,7 +561,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ing.add_argument("paths", nargs="*", help="artifact files")
     ing.add_argument("--scan", default=None,
                      help="also glob BENCH_r*/MULTICHIP_r*/SERVE_r*/"
-                          "perf.json under this directory")
+                          "perf.json/curves.json under this directory")
     ing.add_argument("--out", default="BENCH_TRAJECTORY.json")
     dif = sub.add_parser("diff", help="current vs named baseline, exit "
                                       "nonzero on regression")

@@ -60,6 +60,15 @@ echo "== perfobs smoke (cost ledger -> perf.json + trace export + bench_diff) ==
 # (tools/perfobs_smoke.py asserts all three)
 env JAX_PLATFORMS=cpu python tools/perfobs_smoke.py
 
+echo "== learnobs smoke (learn ledger -> curves.json + /metrics + bench_diff gate) =="
+# a tiny mixed-topology train run must write a complete curves.json
+# (return/TD series + per-topology coverage of both mixture members +
+# envelope summary), land learn_signal events + td/grad/topology gauges,
+# scrape cleanly over the /metrics endpoint, and gate through bench_diff
+# (self-compare rc 0, injected curve regression rc 1) —
+# tools/learnobs_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/learnobs_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -32,6 +32,11 @@ does by default), prints:
 - a device-memory growth check: bytes_in_use at the first vs last episode
   per device, flagged when growth exceeds ``--mem-growth-threshold``
   (a leaking HBM buffer shows as monotonic growth long before an OOM);
+- a learning-dynamics section from the on-device learn ledger's
+  ``learn_signal`` events (gsc_tpu.obs.learning): per-topology
+  |TD-error| table (mixed batches AND the serial path's stamped
+  topology), last-episode Q distribution moments, per-layer grad-norm
+  peaks + param norms, replay fill;
 - a serving section for ``cli serve`` runs, from the ``serve_start`` /
   ``serve_stats`` events (gsc_tpu.serve.PolicyServer): tier, requests/s,
   p50/p99 latency overall and per batch bucket, bucket occupancy, and
@@ -62,7 +67,15 @@ def load_events(path: str) -> List[Dict]:
     """Accept a run dir or the events.jsonl itself; walk rotated segments
     (``--obs-rotate-mb`` writes events.jsonl.N .. .1 before the live
     file) oldest-first so the stream reads as one; skip torn tail lines
-    (the stream may still be appending)."""
+    (the stream may still be appending).
+
+    Events come back SORTED by ``ts`` within each run_start-delimited
+    slice (stable): the hub stamps ``ts`` before taking the sink lock,
+    so concurrent threads can interleave out of order in the file — the
+    phase-delta logic below assumes one monotone stream.  The sort is
+    per-run, never global, so appended runs whose wall clock stepped
+    backwards (NTP, VM resume) cannot interleave across run
+    boundaries."""
     if os.path.isdir(path):
         path = os.path.join(path, "events.jsonl")
     older = []
@@ -85,7 +98,21 @@ def load_events(path: str) -> List[Dict]:
                     events.append(json.loads(line))
                 except json.JSONDecodeError:
                     continue   # torn final line of a live run
-    return events
+    def _ts(e):
+        ts = e.get("ts") if isinstance(e, dict) else None
+        return float(ts) if isinstance(ts, (int, float)) \
+            and not isinstance(ts, bool) else float("-inf")
+
+    out, seg = [], []
+    for e in events:
+        if isinstance(e, dict) and e.get("event") == "run_start" and seg:
+            seg.sort(key=_ts)
+            out.extend(seg)
+            seg = []
+        seg.append(e)
+    seg.sort(key=_ts)
+    out.extend(seg)
+    return out
 
 
 def load_perf(path: str) -> Optional[Dict]:
@@ -298,23 +325,41 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     # mixed-topology section (cli train --topo-mix): harness_episode
     # events carry per-topology mean returns when the batch is a mixture
     # — aggregated here per network name so a collapsing mixture member
-    # is readable off the report, not buried in replica vectors
+    # is readable off the report, not buried in replica vectors.
+    # Single-replica runs stamp a `topology` field on their episode
+    # events instead (the serial trainer path) — merged into the SAME
+    # table, so homogeneous and mixed runs report through one surface.
     topo_mix = (run_start or {}).get("topo_mix")
     per_topology = {}
+
+    def _topo_rec(name):
+        return per_topology.setdefault(
+            name, {"episodes": 0, "sum": 0.0, "last": None})
+
     for ev in events:
-        if ev.get("event") != "harness_episode":
-            continue
-        for name, v in (ev.get("per_topology_return") or {}).items():
-            rec = per_topology.setdefault(
-                name, {"episodes": 0, "sum": 0.0, "last": None})
+        if ev.get("event") == "harness_episode":
+            for name, v in (ev.get("per_topology_return") or {}).items():
+                rec = _topo_rec(name)
+                rec["episodes"] += 1
+                rec["sum"] += float(v)
+                rec["last"] = float(v)
+        elif ev.get("event") == "episode" and ev.get("topology") \
+                and isinstance(ev.get("episodic_return"), (int, float)):
+            rec = _topo_rec(str(ev["topology"]))
             rec["episodes"] += 1
-            rec["sum"] += float(v)
-            rec["last"] = float(v)
+            rec["sum"] += float(ev["episodic_return"])
+            rec["last"] = float(ev["episodic_return"])
     per_topology = {
         name: {"episodes": r["episodes"],
                "mean_return": round(r["sum"] / max(r["episodes"], 1), 3),
                "last_return": round(r["last"], 3)}
         for name, r in per_topology.items()}
+    # learning-dynamics section (the on-device learn ledger,
+    # gsc_tpu.obs.learning): per-topology |TD-error|, Q distribution
+    # moments, per-layer grad/param norm health, replay fill — one
+    # learn_signal event per drained episode
+    learning = _learning_summary(
+        [e for e in events if e.get("event") == "learn_signal"])
     # serving section (cli serve runs): the final serve_stats event holds
     # the cumulative numbers; serve_start carries startup + cache hits
     serve_start = next((e for e in events
@@ -348,6 +393,7 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "mesh": mesh,
         "topo_mix": topo_mix,
         "per_topology": per_topology,
+        "learning": learning,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -360,6 +406,48 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "drop_totals": _drop_totals(episodes),
         "compiles": compile_summary(events, retrace_threshold),
         "perf": perf_summary(perf),
+    }
+
+
+def _learning_summary(learn_events: List[Dict]) -> Optional[Dict]:
+    """Condense the per-episode ``learn_signal`` stream: per-topology
+    |TD| means, first->last overall |TD|, the last episode's Q moments,
+    per-layer grad-norm peaks (exploding gradients show as a peak far
+    above the last value) + last param norms, and replay fill."""
+    if not learn_events:
+        return None
+    per_topo: Dict[str, Dict] = {}
+    grad_peak: Dict[str, float] = {}
+    td_series = []
+    for ev in learn_events:
+        for name, v in (ev.get("per_topology_td") or {}).items():
+            rec = per_topo.setdefault(
+                name, {"episodes": 0, "sum": 0.0, "last": None})
+            rec["episodes"] += 1
+            rec["sum"] += float(v)
+            rec["last"] = float(v)
+        for layer, v in (ev.get("grad_norms") or {}).items():
+            if isinstance(v, (int, float)):
+                grad_peak[layer] = max(grad_peak.get(layer, 0.0), float(v))
+        if isinstance(ev.get("td_abs_mean"), (int, float)):
+            td_series.append(float(ev["td_abs_mean"]))
+    last = learn_events[-1]
+    return {
+        "episodes": len(learn_events),
+        "per_topology_td": {
+            name: {"episodes": r["episodes"],
+                   "mean_td_abs": round(r["sum"] / max(r["episodes"], 1), 6),
+                   "last_td_abs": round(r["last"], 6)}
+            for name, r in per_topo.items()},
+        "td_abs_first": td_series[0] if td_series else None,
+        "td_abs_last": td_series[-1] if td_series else None,
+        "q_last": {k: last.get(k)
+                   for k in ("q_mean", "q_std", "q_min", "q_max")},
+        "grad_norm_peak": {k: round(v, 6)
+                           for k, v in sorted(grad_peak.items())},
+        "grad_norms_last": last.get("grad_norms") or {},
+        "param_norms_last": last.get("param_norms") or {},
+        "replay_fill_last": (last.get("replay") or {}).get("fill"),
     }
 
 
@@ -458,6 +546,29 @@ def render_text(summary: Dict, out=sys.stdout):
         for name, rec in sorted(summary["per_topology"].items()):
             w(f"  {name:<28} {rec['episodes']:>8} "
               f"{rec['mean_return']:>12} {rec['last_return']:>12}\n")
+    ln = summary.get("learning")
+    if ln:
+        w(f"\nlearning dynamics (on-device learn ledger, "
+          f"{ln['episodes']} episode(s)):\n")
+        w(f"  |TD| mean: {ln.get('td_abs_first')} -> "
+          f"{ln.get('td_abs_last')}   Q last: "
+          f"mean {ln['q_last'].get('q_mean')}  std "
+          f"{ln['q_last'].get('q_std')}  min {ln['q_last'].get('q_min')}  "
+          f"max {ln['q_last'].get('q_max')}   replay fill "
+          f"{ln.get('replay_fill_last')}\n")
+        if ln.get("per_topology_td"):
+            w(f"  {'topology':<28} {'episodes':>8} {'mean_|TD|':>12} "
+              f"{'last_|TD|':>12}\n")
+            for name, rec in sorted(ln["per_topology_td"].items()):
+                w(f"  {name:<28} {rec['episodes']:>8} "
+                  f"{rec['mean_td_abs']:>12} {rec['last_td_abs']:>12}\n")
+        if ln.get("grad_norm_peak"):
+            w("  grad/param health (peak grad norm | last grad | "
+              "last param, per layer):\n")
+            for layer in sorted(ln["grad_norm_peak"]):
+                w(f"    {layer:<28} peak {ln['grad_norm_peak'][layer]:>12} "
+                  f" last {_fmt(ln['grad_norms_last'].get(layer), 12)} "
+                  f" param {_fmt(ln['param_norms_last'].get(layer), 12)}\n")
     if perf and perf.get("entries"):
         w("\nperf (device-cost ledger, per watched entry point):\n")
         w(f"  {'entry':<20} {'flops':>12} {'bytes':>12} {'fusions':>8} "
@@ -592,6 +703,23 @@ def _synthetic_events(path: str, episodes: int = 5):
             emit({"event": "compile", "ts": base + k, "run": "selftest",
                   "fn": "leaky_fn", "stage": "trace",
                   "duration_s": 0.1, "count": k + 1})
+        # learn_signal events (the on-device learn ledger): per-topology
+        # |TD| segments, Q moments, layer norms, replay fill — the
+        # learning-dynamics section must surface the TD trend, the
+        # per-layer grad-norm peak and the replay fill
+        for ep in range(2):
+            emit({"event": "learn_signal", "ts": base + ep + 0.5,
+                  "run": "selftest", "episode": ep,
+                  "td_abs_mean": 0.5 - 0.1 * ep,
+                  "per_topology_td": {"abilene.graphml": 0.4,
+                                      "abilene+bursty": 0.6 - 0.1 * ep},
+                  "q_mean": 0.3, "q_std": 0.1, "q_min": -0.2, "q_max": 0.9,
+                  "grad_norms": {"actor/Dense_0": 1.5 + ep,
+                                 "critic/Dense_0": 2.0},
+                  "param_norms": {"actor/Dense_0": 10.0,
+                                  "critic/Dense_0": 12.0},
+                  "replay": {"size": [16], "fill": 0.5,
+                             "age_mean_steps": 7.5}})
         disp = drain = 0.0
         for ep in range(episodes):
             disp += 0.010
@@ -599,6 +727,9 @@ def _synthetic_events(path: str, episodes: int = 5):
             emit({"event": "episode", "ts": base + ep, "run": "selftest",
                   "episode": ep, "global_step": 4 * ep + 3,
                   "sps": 100.0 + ep, "episodic_return": -1.0 + 0.1 * ep,
+                  # serial-path topology identity: single-replica runs
+                  # stamp the scheduled network on their episode events
+                  "topology": "line3.graphml",
                   "mean_succ_ratio": 0.5, "critic_loss": 0.2,
                   "actor_loss": -0.1, "q_values": 0.3,
                   "drop_reasons": {"TTL": ep, "DECISION": 0,
@@ -734,8 +865,22 @@ def selftest() -> int:
             "abilene.graphml": {"episodes": 2, "mean_return": 2.5,
                                 "last_return": 3.0},
             "abilene+bursty": {"episodes": 2, "mean_return": 0.5,
-                               "last_return": 1.0}}, \
+                               "last_return": 1.0},
+            # the serial path's stamped episode events land in the SAME
+            # table as the harness's mixed-batch attribution
+            "line3.graphml": {"episodes": 5, "mean_return": -0.8,
+                              "last_return": -0.6}}, \
             "per-topology returns not aggregated"
+        ln = summary["learning"]
+        assert ln and ln["episodes"] == 2, ln
+        assert ln["per_topology_td"]["abilene+bursty"] == {
+            "episodes": 2, "mean_td_abs": 0.55, "last_td_abs": 0.5}, ln
+        assert ln["td_abs_first"] == 0.5 and ln["td_abs_last"] == 0.4, ln
+        assert ln["q_last"] == {"q_mean": 0.3, "q_std": 0.1,
+                                "q_min": -0.2, "q_max": 0.9}, ln
+        assert ln["grad_norm_peak"]["actor/Dense_0"] == 2.5, \
+            "per-layer grad-norm peak not tracked"
+        assert ln["replay_fill_last"] == 0.5, ln
         import io
         txt = io.StringIO()
         render_text(summary, out=txt)
@@ -753,6 +898,9 @@ def selftest() -> int:
         assert "per-topology returns" in txt.getvalue() \
             and "abilene+bursty" in txt.getvalue(), \
             "per-topology table not rendered"
+        assert "learning dynamics" in txt.getvalue() \
+            and "grad/param health" in txt.getvalue(), \
+            "learning-dynamics section not rendered"
         assert len(summary["stalls"]) == 1, "stall not surfaced"
         assert summary["stalls"][0]["last_phase"] == "dispatch"
         assert len(summary["invariant_violations"]) == 1
@@ -782,10 +930,16 @@ def selftest() -> int:
         assert abs(deltas[2]["dispatch"] - 0.010) < 1e-6, deltas[2]
         render_text(summary)   # must not raise on a flagged stream
         # append-mode reuse: a second run landing in the same stream must
-        # not corrupt the summary — the report partitions on run_start
-        body = open(path).read()
+        # not corrupt the summary — the report partitions on run_start.
+        # The appended run's timestamps are SHIFTED (a real second run
+        # starts later; the reader now ts-sorts, so an identical-ts copy
+        # would interleave with the first run's records)
+        lines0 = [json.loads(line) for line in open(path)
+                  if line.strip()]
         with open(path, "a") as f:
-            f.write(body)
+            for rec in lines0:
+                f.write(json.dumps({**rec, "ts": rec["ts"] + 1000.0})
+                        + "\n")
         s2 = summarize(load_events(path))
         assert s2["runs_in_stream"] == 2 and s2["episodes"] == 5, s2
         render_text(s2, out=open(os.devnull, "w"))
@@ -798,7 +952,9 @@ def selftest() -> int:
             f.writelines(lines[:cut])
         with open(path, "w") as f:
             f.writelines(lines[cut:])
-        reassembled = [json.loads(line) for line in lines if line.strip()]
+        reassembled = sorted(
+            (json.loads(line) for line in lines if line.strip()),
+            key=lambda e: e["ts"])   # the reader's ts-sorted view
         assert load_events(path) == reassembled, \
             "rotated segments did not reassemble the stream"
         s3 = summarize(load_events(path))
