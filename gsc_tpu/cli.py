@@ -860,12 +860,29 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
                    "histograms, queue depth and bucket occupancy are "
                    "scrapeable while the server runs.  0 = disabled; "
                    "requires --obs")
+@click.option("--trace-sample", default=0, show_default=True,
+              help="head-sample every Nth request into a "
+                   "serve_request_span event (queue-wait / batch-wait / "
+                   "device / fan-out split; the trace exporter renders "
+                   "them flow-linked to their flush).  0 = request "
+                   "spans off; flush-level serve_flush spans and the "
+                   "latency-decomposition histograms are always "
+                   "recorded under --obs.  Requires --obs")
+@click.option("--slo-p99-ms", default=None,
+              help="declarative latency objective(s) the SLO engine "
+                   "judges rolling attainment + error-budget burn "
+                   "against.  Grammar: '<ms>' overall, "
+                   "'<bucket>:<ms>' per bucket, comma-separated — e.g. "
+                   "'25' or '25,8:60'.  Off by default (deadline-miss "
+                   "ratio, pad waste and arrival rate are tracked "
+                   "regardless).  Requires --obs")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 def serve(agent_config, simulator_config, service, scheduler, checkpoint,
           requests, concurrency, buckets, deadline_ms, artifact_cache,
           pool_steps, stats_interval, request_timeout, seed, max_nodes,
           max_edges, resource_functions_path, result_dir, obs_enabled,
-          obs_dir, perf_enabled, metrics_port, jax_cache_dir):
+          obs_dir, perf_enabled, metrics_port, trace_sample, slo_p99_ms,
+          jax_cache_dir):
     """Serve coordination decisions from an AOT-compiled greedy policy.
 
     With CHECKPOINT: restores the actor, ahead-of-time compiles the
@@ -907,6 +924,19 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     if metrics_port and not obs_enabled:
         raise click.BadParameter("--metrics-port needs the run observer "
                                  "(drop --no-obs)")
+    if trace_sample < 0:
+        raise click.BadParameter("--trace-sample must be >= 0 "
+                                 "(0 = request spans off)")
+    if (trace_sample or slo_p99_ms) and not obs_enabled:
+        raise click.BadParameter("--trace-sample/--slo-p99-ms need the "
+                                 "run observer (drop --no-obs)")
+    slo_objectives = None
+    if slo_p99_ms:
+        from .obs import parse_slo_spec
+        try:
+            slo_objectives = parse_slo_spec(slo_p99_ms)
+        except ValueError as e:
+            raise click.BadParameter(f"--slo-p99-ms {slo_p99_ms!r}: {e}")
     jax_cache_dir = _apply_jax_cache(jax_cache_dir)
 
     precision = None
@@ -948,6 +978,7 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
             "mode": "serve", "tier": tier, "seed": seed,
             "requests": requests, "concurrency": concurrency,
             "buckets": list(bucket_sizes), "deadline_ms": deadline_ms,
+            "trace_sample": trace_sample, "slo_p99_ms": slo_p99_ms,
             "precision": agent.precision,
             "substep_impl": env.sim_cfg.substep_impl,
             "unroll": env.sim_cfg.scan_unroll,
@@ -962,6 +993,16 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     else:
         from .obs import MetricsHub
         hub = MetricsHub(tags={"seed": seed})
+    # request-path tracing + SLO engine ride the observer: flush spans
+    # and decomposition always recorded under --obs, request spans
+    # head-sampled by --trace-sample, slo.json written at close.  With
+    # --no-obs the server runs the historic tracer-free path.
+    tracer = None
+    slo_path = None
+    if obs_rec is not None:
+        from .obs import ServeTracer
+        tracer = ServeTracer(hub=hub, sample=trace_sample)
+        slo_path = obs_rec.slo_path
 
     try:
         if checkpoint:
@@ -983,12 +1024,14 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
                 substep_impl=env.sim_cfg.substep_impl,
                 graph_mode=agent.graph_mode, hub=hub,
                 stats_interval=stats_interval,
-                perf=(obs_rec.perf if obs_rec is not None else None))
+                perf=(obs_rec.perf if obs_rec is not None else None),
+                tracer=tracer, slo=slo_objectives, slo_path=slo_path)
         else:
             server = PolicyServer(
                 fallback=SPRFallbackPolicy(topo, env.limits, obs0),
                 buckets=bucket_sizes, deadline_ms=deadline_ms, hub=hub,
-                stats_interval=stats_interval)
+                stats_interval=stats_interval,
+                tracer=tracer, slo=slo_objectives, slo_path=slo_path)
         server.start()
 
         # closed-loop load: each client thread submits its share
@@ -1041,6 +1084,7 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
         "p50_ms": round(lat.get("p50", 0.0), 3),
         "p99_ms": round(lat.get("p99", 0.0), 3),
         "buckets": per_bucket,
+        "slo": server.slo_summary(),
         "startup": server.startup,
         "artifact_cache": cache_dir if checkpoint else None,
         "jax_cache_dir": jax_cache_dir,

@@ -40,6 +40,13 @@ pipelines honest; this package is that substrate:
 - :class:`MetricsEndpoint` (:mod:`~gsc_tpu.obs.endpoint`) — live
   ``/metrics`` HTTP endpoint (stdlib, Prometheus text exposition) over
   the hub snapshot, so long runs are scrapeable while they execute.
+- :class:`SLOEngine` / :class:`ServeTracer` (:mod:`~gsc_tpu.obs.slo`) —
+  the serving-tier currency: per-request span tracing (queue-wait /
+  batch-wait / device / fan-out decomposition of ``serve_latency_ms``,
+  head-sampled ``serve_request_span`` events, deferred off the flush
+  path) and declarative latency SLOs (rolling attainment, error-budget
+  burn rate, deadline-miss ratio, arrival-rate EWMA, pad waste) folded
+  into ``serve_stats``, ``/metrics`` and the per-run ``slo.json``.
 - :mod:`~gsc_tpu.obs.curves` — per-run learning-curve extraction:
   events.jsonl -> schema-versioned ``curves.json`` whose summary metrics
   (final-window return, AUC, episodes-to-threshold)
@@ -60,6 +67,8 @@ from .learning import LearnLedger, LearnLedgerSpec, emit_learn_signal
 from .perf import PERF_SCHEMA_VERSION, CostLedger
 from .run import RunObserver
 from .sinks import JsonlSink, ListSink, rotated_paths, write_atomic_json
+from .slo import (SLO_SCHEMA_VERSION, ServeTracer, SLOEngine,
+                  SLOObjectives, parse_slo_spec, write_slo_json)
 from .watchdog import PipelineWatchdog
 
 __all__ = [
@@ -69,4 +78,6 @@ __all__ = [
     "PERF_SCHEMA_VERSION", "LearnLedger", "LearnLedgerSpec",
     "emit_learn_signal", "MetricsEndpoint", "prometheus_text",
     "CURVES_SCHEMA_VERSION", "extract_curves", "write_curves",
+    "SLO_SCHEMA_VERSION", "SLOEngine", "SLOObjectives", "ServeTracer",
+    "parse_slo_spec", "write_slo_json",
 ]

@@ -10,7 +10,9 @@ series names ``gsc_<name>{tag="v",...}`` are already exposition-shaped).
 Deliberately jax-free and read-only: the handler thread only ever calls
 ``hub.snapshot()`` (one lock acquisition, O(series)), never touches the
 training loop, and serves on a daemon thread — a wedged scraper cannot
-stall a dispatch.  Wired via ``RunObserver(metrics_port=...)`` /
+stall a dispatch.  Gauges registered via ``hub.live_gauge`` (e.g. the
+serving queue depth) are re-probed inside every snapshot, so a scrape
+mid-run reads the CURRENT value, not the last event-writer sample.  Wired via ``RunObserver(metrics_port=...)`` /
 ``cli train --metrics-port`` (default off); ``cli serve`` reuses it for
 the serving hub.
 

@@ -86,6 +86,7 @@ class MetricsHub:
             k: str(v) for k, v in (tags or {}).items()}
         self._counters: Dict[_Key, float] = {}
         self._gauges: Dict[_Key, float] = {}
+        self._live_gauges: Dict[_Key, object] = {}   # name -> callable
         self._hists: Dict[_Key, _Histogram] = {}
         self._beats: Dict[str, float] = {}       # name -> time.monotonic()
         self._last_phase: Optional[str] = None
@@ -113,6 +114,19 @@ class MetricsHub:
     def get_gauge(self, name: str, **tags) -> Optional[float]:
         with self._lock:
             return self._gauges.get(_key(name, tags))
+
+    def live_gauge(self, name: str, probe, **tags):
+        """Register a zero-arg probe sampled at every :meth:`snapshot` —
+        the /metrics endpoint scrapes through snapshot, so a live probe
+        (e.g. the serve queue depth) stays current between the event
+        writers' explicit samples.  The probe runs under the hub lock:
+        keep it O(1) and lock-free (a ``qsize()``, a counter read)."""
+        with self._lock:
+            self._live_gauges[_key(name, tags)] = probe
+
+    def drop_live_gauge(self, name: str, **tags):
+        with self._lock:
+            self._live_gauges.pop(_key(name, tags), None)
 
     def observe(self, name: str, value: float, **tags):
         """Histogram sample (count/sum/min/max + windowed percentiles)."""
@@ -182,8 +196,16 @@ class MetricsHub:
 
     # ------------------------------------------------------------ snapshot
     def snapshot(self) -> Dict[str, float]:
-        """Flat ``{prometheus_name: value}`` view of every live series."""
+        """Flat ``{prometheus_name: value}`` view of every live series.
+        Live-gauge probes are sampled first (their latest value also
+        lands in the plain gauge table, so ``get_gauge`` and later
+        snapshots agree with what was served)."""
         with self._lock:
+            for k, probe in list(self._live_gauges.items()):
+                try:
+                    self._gauges[k] = float(probe())
+                except Exception:   # a dead probe must not break scrapes
+                    pass
             base = tuple(self.base_tags.items())
             merge = lambda tags: tuple(sorted(base + tags))
             out: Dict[str, float] = {}

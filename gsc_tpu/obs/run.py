@@ -45,6 +45,9 @@ class RunObserver:
         self.snapshot_path = os.path.join(self.out_dir, "metrics.json")
         self.perf_path = os.path.join(self.out_dir, "perf.json")
         self.curves_path = os.path.join(self.out_dir, "curves.json")
+        # serving SLO summary (obs.slo): PolicyServer.close() writes it
+        # when `cli serve` hands the server this path
+        self.slo_path = os.path.join(self.out_dir, "slo.json")
         # size-based rotation for 100+-episode exhibits (``--obs-rotate-mb``)
         # — readers walk the rotated segments via sinks.rotated_paths
         self.hub.add_sink(JsonlSink(self.events_path, rotate_mb=rotate_mb))

@@ -18,9 +18,13 @@ stalls, recovery ladders, compile events, serve stats), but reading a
 stall out of log-line timestamp deltas is archaeology.
 :func:`build_trace` renders the stream into Chrome trace-event JSON
 (the format Perfetto / ``chrome://tracing`` open directly): one track
-per logical thread — episode loop, prefetcher, serve, watchdog, compile
-— with watchdog stalls as instant events and recovery/rollback ladders
-chained by flow arrows.  Phase sub-spans are RECONSTRUCTED from the
+per logical thread — episode loop, prefetcher, serve, serve_request,
+watchdog, compile — with watchdog stalls as instant events,
+recovery/rollback ladders chained by flow arrows, batcher flushes as
+complete slices on the serve track, and head-sampled
+``serve_request_span`` events as slices on the serve_request track
+whose flow arrows link each request through its batcher flush to the
+device call that answered it.  Phase sub-spans are RECONSTRUCTED from the
 cumulative per-episode deltas (laid back-to-back inside each episode's
 span and clamped to it), so they show relative share faithfully but not
 exact start times.  :func:`validate_trace` is the strict schema check
@@ -74,12 +78,14 @@ def episode_span(step: int, name: str = "episode_step"):
 # Stable API: tools and tests reference these names.
 TRACE_PID = 1
 TRACE_TRACKS = {
-    "episode": 1,      # training loop: episode spans + phase sub-spans
-    "prefetcher": 2,   # producer-thread restarts
-    "serve": 3,        # serve_start / serve_stats counters
-    "watchdog": 4,     # stalls, escalations, invariant violations
-    "compile": 5,      # jit trace/XLA compile spans + compile_cost marks
-    "recovery": 6,     # self-healing ladder, chained by flow arrows
+    "episode": 1,        # training loop: episode spans + phase sub-spans
+    "prefetcher": 2,     # producer-thread restarts
+    "serve": 3,          # serve_start/serve_stats counters + flush slices
+    "watchdog": 4,       # stalls, escalations, invariant violations
+    "compile": 5,        # jit trace/XLA compile spans + compile_cost marks
+    "recovery": 6,       # self-healing ladder, chained by flow arrows
+    "serve_request": 7,  # head-sampled request spans, flow-linked to the
+                         # batcher flush that answered them
 }
 # phase sub-span layout order inside an episode slice (the obs schema's
 # cumulative PhaseTimer names)
@@ -206,6 +212,24 @@ def build_trace(events: List[Dict]) -> Dict:
     recoveries = [e for e in events if e.get("event") == "recovery"]
     rec_index = {id(e): i for i, e in enumerate(recoveries)}
     flow_id = 0
+    # serving flushes index ((run-segment, flush_id) -> dispatch ts_us):
+    # sampled request spans flow-arrow into the flush slice that
+    # answered them; built up front because span events carry their
+    # ENQUEUE wall time, which always precedes the flush's dispatch time
+    # in the sorted stream.  Keyed per run_start segment, not by
+    # flush_id alone — appended runs in a reused --obs-dir each restart
+    # their flush ids at 0, and a run-1 span must never arrow into a
+    # run-2 flush slice
+    seg_of: Dict[int, int] = {}
+    seg = 0
+    for e in events:
+        if e.get("event") == "run_start":
+            seg += 1
+        seg_of[id(e)] = seg
+    flush_ts = {(seg_of[id(e)], e.get("flush_id")): _us(float(e["ts"]), t0)
+                for e in events
+                if e.get("event") == "serve_flush"
+                and e.get("flush_id") is not None}
 
     for ev in events:
         kind = ev.get("event")
@@ -320,6 +344,37 @@ def build_trace(events: List[Dict]) -> Dict:
                  args={"rps": float(ev.get("rps") or 0.0),
                        "p99_ms": float(ev.get("p99_ms") or 0.0),
                        "queue_depth": float(ev.get("queue_depth") or 0)})
+        elif kind == "serve_flush":
+            # one complete slice per device call ("X": self-contained
+            # duration, so overlapping flushes never unbalance a B/E
+            # stack); ts is the dispatch wall time the tracer pinned
+            dur = round(max(float(ev.get("device_ms") or 0.0), 0.0)
+                        * 1e3, 1)
+            push("X", f"flush b{ev.get('bucket')}", TRACE_TRACKS["serve"],
+                 ts_us, dur=dur,
+                 args={"flush_id": ev.get("flush_id"),
+                       "n_real": ev.get("n_real"),
+                       "pad_fraction": ev.get("pad_fraction")})
+        elif kind == "serve_request_span":
+            # sampled request: enqueue -> fan-out as one slice, with the
+            # queue/batch/device/fan-out split in args; a flow arrow
+            # links it to its flush's slice on the serve track
+            total_ms = (float(ev.get("latency_ms") or 0.0)
+                        + max(float(ev.get("fanout_ms") or 0.0), 0.0))
+            push("X", f"req {ev.get('trace_id')}",
+                 TRACE_TRACKS["serve_request"], ts_us,
+                 dur=round(max(total_ms, 0.0) * 1e3, 1),
+                 args={k: ev.get(k) for k in
+                       ("trace_id", "flush_id", "bucket", "queue_wait_ms",
+                        "batch_wait_ms", "device_ms", "fanout_ms",
+                        "latency_ms", "deadline_miss")})
+            f_ts = flush_ts.get((seg_of[id(ev)], ev.get("flush_id")))
+            if f_ts is not None and f_ts >= ts_us:
+                flow_id += 1
+                push("s", "serve_req", TRACE_TRACKS["serve_request"],
+                     ts_us, id=flow_id)
+                push("f", "serve_req", TRACE_TRACKS["serve"], f_ts,
+                     id=flow_id, bp="e")
         # other event kinds (precision, harness_episode, ...) carry no
         # timeline geometry — the report renders them, the trace skips them
 

@@ -18,7 +18,22 @@ through the shared :class:`~gsc_tpu.obs.MetricsHub`:
 - ``serve_latency_ms`` histogram (overall and tagged per bucket),
 - ``serve_batch_ms`` device-call histogram per bucket,
 - ``serve_requests_total`` / ``serve_batches_total{bucket=..}`` counters,
-- ``serve_queue_depth`` gauge sampled at every flush.
+- ``serve_rejected_total{reason=queue_full|stopping}`` for overload
+  rejections (counted BEFORE the ServeError reaches the caller, so
+  rejected load is visible in telemetry, not only in client stacks),
+- ``serve_queue_depth`` gauge sampled at every submit AND every flush
+  (submit-side sampling keeps it honest between flushes and while idle).
+
+Request-path tracing: every request carries a monotonically increasing
+``trace_id`` and is stamped at enqueue, batch admission (popped off the
+queue into a forming batch), device dispatch and completion.  With a
+:class:`~gsc_tpu.obs.slo.ServeTracer` attached, ``_flush`` hands the
+stamped batch over as ONE compact record (a deque append of plain
+floats — the flush path does timestamps + deferred emission only, no
+derived math, no I/O); the tracer's drainer thread later decomposes
+``serve_latency_ms`` into queue-wait / batch-formation wait / device
+wall / fan-out, feeds the SLO engine and emits the span events.  With
+``tracer=None`` the batcher behaves byte-for-byte as before.
 
 The batcher is transport-agnostic: ``submit`` is the in-process API
 (``PolicyServer`` wraps it); an RPC front-end would call the same method.
@@ -42,15 +57,27 @@ class ServeError(RuntimeError):
 
 class ServeFuture:
     """Minimal future for one request: blocks on ``result`` until the
-    batcher fills it (or raises what the device call raised)."""
+    batcher fills it (or raises what the device call raised).
 
-    __slots__ = ("_event", "_result", "_error", "t_enqueued")
+    Span timestamps (``time.perf_counter`` for intervals, one wall-clock
+    ``time.time`` at enqueue for trace geometry) are stamped as the
+    request moves: enqueue here, batch admission in the consumer loop,
+    completion after the device result fans out.  Stamping is
+    unconditional — timestamps are the only work the tracing contract
+    allows on the serve path, and they cost nanoseconds."""
+
+    __slots__ = ("_event", "_result", "_error", "t_enqueued",
+                 "wall_enqueued", "t_admitted", "t_completed", "trace_id")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
         self.t_enqueued = time.perf_counter()
+        self.wall_enqueued = time.time()
+        self.t_admitted: Optional[float] = None
+        self.t_completed: Optional[float] = None
+        self.trace_id: int = -1
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -79,7 +106,8 @@ class MicroBatcher:
                  buckets: Sequence[int] = (1, 4, 8),
                  deadline_ms: float = 5.0, hub=None,
                  max_queue: int = 4096,
-                 on_flush: Optional[Callable[[int, int], None]] = None):
+                 on_flush: Optional[Callable[[int, int], None]] = None,
+                 tracer=None):
         if not buckets or any(b < 1 for b in buckets):
             raise ValueError(f"buckets must be positive ints: {buckets!r}")
         self.run_batch = run_batch
@@ -88,6 +116,11 @@ class MicroBatcher:
         self.deadline_s = float(deadline_ms) / 1e3
         self.hub = hub
         self.on_flush = on_flush
+        # obs.slo.ServeTracer (or None): receives one compact record per
+        # flush + rejection notes; all span math/emission happens on ITS
+        # drainer thread, never here
+        self.tracer = tracer
+        self._next_trace_id = 0
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
@@ -130,19 +163,37 @@ class MicroBatcher:
         """Enqueue one request (any obs pytree matching the template).
         Template validation happens HERE, in the caller's thread — a
         malformed request raises at the call site and never reaches the
-        shared device path."""
+        shared device path.  A rejection (stopping / queue full) bumps
+        ``serve_rejected_total{reason=..}`` BEFORE raising, so overload
+        shows up in serve_stats and /metrics instead of vanishing into
+        client-side exceptions."""
         leaves = self.template.flatten(obs)
         fut = ServeFuture()
         with self._submit_lock:
             if self._stopping:
+                self._note_rejection("stopping", fut)
                 raise ServeError("batcher is stopping — request rejected")
+            fut.trace_id = self._next_trace_id
+            self._next_trace_id += 1
             try:
                 self._q.put_nowait((fut, leaves))
             except queue.Full:
+                self._note_rejection("queue_full", fut)
                 raise ServeError(
                     f"serve queue full ({self._q.maxsize} requests) — "
                     "backpressure: retry or add capacity")
+        # live depth between flushes: the flush-side sample alone reads
+        # stale while requests pile up or the queue sits idle
+        if self.hub is not None:
+            self.hub.gauge("serve_queue_depth", self._q.qsize())
         return fut
+
+    def _note_rejection(self, reason: str, fut: ServeFuture):
+        if self.hub is not None:
+            self.hub.counter("serve_rejected_total", reason=reason)
+            self.hub.gauge("serve_queue_depth", self._q.qsize())
+        if self.tracer is not None:
+            self.tracer.note_rejection(reason, fut.wall_enqueued)
 
     # ---------------------------------------------------------------- loop
     def _loop(self):
@@ -150,6 +201,7 @@ class MicroBatcher:
             item = self._q.get()
             if item is _STOP:
                 break
+            item[0].t_admitted = time.perf_counter()
             batch: List[Tuple[ServeFuture, List[np.ndarray]]] = [item]
             deadline = item[0].t_enqueued + self.deadline_s
             stop_after = False
@@ -170,6 +222,7 @@ class MicroBatcher:
                 if nxt is _STOP:
                     stop_after = True
                     break
+                nxt[0].t_admitted = time.perf_counter()
                 batch.append(nxt)
             self._flush(batch)
             if stop_after:
@@ -194,6 +247,7 @@ class MicroBatcher:
         bucket = next(b for b in self.buckets if b >= k)
         stacked = self.template.stack_pad([leaves for _, leaves in batch],
                                           bucket)
+        wall_dispatch = time.time()
         t0 = time.perf_counter()
         try:
             out = self.run_batch(stacked, k, bucket)
@@ -203,6 +257,23 @@ class MicroBatcher:
                 fut._event.set()
             if self.hub is not None:
                 self.hub.counter("serve_errors_total")
+            if self.tracer is not None:
+                # a failed device call must BURN the SLO budget, not
+                # vanish from it: the engine counts these requests as
+                # deadline misses / objective violations (they were
+                # never answered), so attainment and the gated slo_*
+                # metrics degrade with real serving failures
+                self.tracer.record_flush({
+                    "bucket": bucket, "n_real": k,
+                    "wall_dispatch": wall_dispatch,
+                    "t_dispatch": t0,
+                    "t_device_done": time.perf_counter(),
+                    "queue_depth": self._q.qsize(),
+                    "error": f"{type(e).__name__}: {e}",
+                    "requests": [(fut.trace_id, fut.wall_enqueued,
+                                  fut.t_enqueued, fut.t_admitted, None)
+                                 for fut, _ in batch],
+                })
             return
         now = time.perf_counter()
         out = np.asarray(out)
@@ -214,11 +285,30 @@ class MicroBatcher:
                 self.hub.observe("serve_latency_ms", lat_ms,
                                  bucket=bucket)
             fut._event.set()
+            fut.t_completed = time.perf_counter()
         if self.hub is not None:
             self.hub.counter("serve_requests_total", k)
             self.hub.counter("serve_batches_total", bucket=bucket)
             self.hub.observe("serve_batch_ms", (now - t0) * 1e3,
                              bucket=bucket)
             self.hub.gauge("serve_queue_depth", self._q.qsize())
+        if self.tracer is not None:
+            # deferred span emission: hand over the raw timestamps as one
+            # record (plain floats, O(batch) appends) — the tracer's
+            # drainer thread derives the queue/batch/device/fan-out
+            # decomposition and emits the events off this thread.
+            # `now` doubles as the device-done stamp, so the tracer's
+            # reconstructed latency equals the serve_latency_ms values
+            # recorded above exactly.
+            self.tracer.record_flush({
+                "bucket": bucket, "n_real": k,
+                "wall_dispatch": wall_dispatch,
+                "t_dispatch": t0, "t_device_done": now,
+                "queue_depth": self._q.qsize(),
+                "requests": [(fut.trace_id, fut.wall_enqueued,
+                              fut.t_enqueued, fut.t_admitted,
+                              fut.t_completed)
+                             for fut, _ in batch],
+            })
         if self.on_flush is not None:
             self.on_flush(k, bucket)

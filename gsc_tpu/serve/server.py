@@ -18,8 +18,20 @@ Observability rides the run's :class:`~gsc_tpu.obs.MetricsHub`: the
 batcher feeds the latency/queue series (see its module doc), the server
 emits one ``serve_start`` event (tier, buckets, per-bucket cache hit +
 prepare wall, total startup) and periodic + final ``serve_stats`` events
-(requests, requests/s, p50/p99 overall and per bucket, occupancy) —
-``tools/obs_report.py`` renders them as the serving section.
+(requests, requests/s, p50/p99 overall and per bucket, occupancy,
+rejections, and — with a tracer attached — the latency decomposition
+per bucket plus the SLO snapshot) — ``tools/obs_report.py`` renders
+them as the serving section.
+
+Request-path tracing + SLO: pass a
+:class:`~gsc_tpu.obs.slo.ServeTracer` (``tracer=``) to decompose every
+request's latency into queue-wait / batch-wait / device / fan-out and
+emit ``serve_flush`` + head-sampled ``serve_request_span`` events;
+``slo=`` (an :class:`~gsc_tpu.obs.slo.SLOObjectives`) declares latency
+objectives the engine tracks rolling attainment and error-budget burn
+against, and ``slo_path=`` makes :meth:`close` write the final SLO
+summary as ``slo.json``.  All three default off — the historic serve
+path is byte-identical without them.
 
 Without a checkpoint the server runs the SPR fallback tier
 (:class:`~gsc_tpu.serve.fallback.SPRFallbackPolicy`) through the same
@@ -69,7 +81,8 @@ class PolicyServer:
                  precision: str = "f32", substep_impl: str = "xla",
                  graph_mode: bool = True,
                  hub=None, stats_interval: int = 50,
-                 max_queue: int = 4096, perf=None):
+                 max_queue: int = 4096, perf=None,
+                 tracer=None, slo=None, slo_path: Optional[str] = None):
         if (policy is None) == (fallback is None):
             raise ValueError("exactly one of policy (learned tier, with "
                              "params) or fallback (SPR tier) is required")
@@ -92,6 +105,17 @@ class PolicyServer:
         # measured latency histograms merge in at close() — perf.json
         # then carries per-bucket MFU next to the training entry points
         self.perf = perf
+        # request-path tracing + SLO engine (obs.slo): the tracer turns
+        # the batcher's timestamp records into span events and latency
+        # decomposition on its own drainer thread; the engine (created
+        # in start() when a tracer is attached) tracks deadline misses,
+        # pad waste, arrival rate and — when `slo` declares objectives —
+        # rolling attainment + error-budget burn.  slo_path: where
+        # close() writes the final summary document (None = don't).
+        self.tracer = tracer
+        self.slo = slo
+        self.slo_path = slo_path
+        self.slo_engine = None
         self.stats_interval = max(int(stats_interval), 1)
         self.max_queue = max_queue
         self.batcher: Optional[MicroBatcher] = None
@@ -114,10 +138,24 @@ class PolicyServer:
         else:
             template = self.fallback.template
             run_batch = self.fallback.run_batch
+        if self.tracer is not None:
+            from ..obs.slo import SLOEngine
+            self.slo_engine = SLOEngine(deadline_ms=self.deadline_ms,
+                                        objectives=self.slo, hub=self.hub)
+            self.tracer.bind_engine(self.slo_engine)
+            self.tracer.start()
         self.batcher = MicroBatcher(
             run_batch, template, buckets=self.buckets,
             deadline_ms=self.deadline_ms, hub=self.hub,
-            max_queue=self.max_queue, on_flush=self._on_flush).start()
+            max_queue=self.max_queue, on_flush=self._on_flush,
+            tracer=self.tracer).start()
+        if self.hub is not None and hasattr(self.hub, "live_gauge"):
+            # the /metrics endpoint snapshots the hub on every scrape —
+            # a live probe keeps serve_queue_depth current mid-run
+            # instead of frozen at the last flush/submit sample
+            batcher = self.batcher
+            self.hub.live_gauge("serve_queue_depth",
+                                lambda: batcher.queue_depth)
         self._t_started = time.perf_counter()
         self.startup = {
             "tier": self.tier,
@@ -191,7 +229,21 @@ class PolicyServer:
         if self.batcher is not None:
             self.batcher.stop()
             self.batcher = None
+        if self.hub is not None and hasattr(self.hub, "drop_live_gauge"):
+            self.hub.drop_live_gauge("serve_queue_depth")
+            self.hub.gauge("serve_queue_depth", 0)
+        if self.tracer is not None:
+            # final drain BEFORE the final stats event, so the last
+            # flushes' spans and SLO updates are in the summary
+            self.tracer.stop()
         self._emit_stats(final=True)
+        if self.slo_engine is not None and self.slo_path is not None:
+            from ..obs.slo import write_slo_json
+            try:
+                write_slo_json(self.slo_path, self._slo_doc())
+            except OSError as e:   # a full disk must not mask teardown
+                log.warning("slo.json not written to %s: %s",
+                            self.slo_path, e)
         if self.perf is not None and self.hub is not None:
             # measured per-bucket FLUSH wall -> ledger timings: the
             # batcher's serve_batch_ms histogram wraps exactly one
@@ -239,6 +291,69 @@ class PolicyServer:
         tags = {"bucket": bucket} if bucket is not None else {}
         return self.hub.histogram_summary("serve_latency_ms", **tags)
 
+    def _rejected_totals(self) -> Dict[str, int]:
+        if self.hub is None:
+            return {}
+        return {reason: int(self.hub.get_counter("serve_rejected_total",
+                                                 reason=reason))
+                for reason in ("queue_full", "stopping")}
+
+    def _decomposition(self) -> Dict[str, Dict[str, float]]:
+        """Per-bucket latency-split means from the tracer's histograms:
+        queue-wait, batch-formation wait, device wall (the historic
+        serve_batch_ms), fan-out."""
+        if self.hub is None:
+            return {}
+        out: Dict[str, Dict[str, float]] = {}
+        for b in self.buckets:
+            row = {}
+            for metric, key in (("serve_queue_wait_ms", "queue_ms"),
+                                ("serve_batch_wait_ms", "batch_ms"),
+                                ("serve_batch_ms", "device_ms"),
+                                ("serve_fanout_ms", "fanout_ms")):
+                s = self.hub.histogram_summary(metric, bucket=b)
+                if s and s.get("count"):
+                    row[key] = round(s["mean"], 4)
+            if row:
+                out[str(b)] = row
+        return out
+
+    def slo_summary(self) -> Optional[Dict]:
+        """Compact SLO verdict for the CLI's JSON output / serve_bench
+        banking (the slo.json document is the full version)."""
+        if self.slo_engine is None:
+            return None
+        snap = self.slo_engine.snapshot()
+        out = {k: snap.get(k) for k in
+               ("requests", "deadline_misses", "deadline_miss_ratio",
+                "attainment", "burn_rate", "pad_waste",
+                "queue_wait_frac", "arrival_rate_rps", "rejected")}
+        out["p99_target_ms"] = (snap.get("objectives") or {}).get("p99_ms")
+        return out
+
+    def _slo_doc(self) -> Dict:
+        """The full ``slo.json`` payload: engine snapshot + serving
+        context + latency decomposition + overall percentiles."""
+        from ..obs.slo import SLO_SCHEMA_VERSION
+
+        lat = self.latency_summary() or {}
+        doc = {
+            "schema_version": SLO_SCHEMA_VERSION,
+            "ts": round(time.time(), 3),
+            "run": (self.hub.base_tags.get("run")
+                    if self.hub is not None else None),
+            "tier": self.tier,
+            "buckets": list(self.buckets),
+            "requests_completed": self._completed,
+            "p50_latency_ms": round(lat.get("p50", 0.0), 4),
+            "p99_latency_ms": round(lat.get("p99", 0.0), 4),
+            "decomposition_ms": self._decomposition(),
+            "spans_dropped": (self.tracer.spans_dropped
+                              if self.tracer is not None else 0),
+        }
+        doc.update(self.slo_engine.snapshot())
+        return doc
+
     def _emit_stats(self, final: bool = False):
         if self.hub is None:
             return
@@ -252,6 +367,27 @@ class PolicyServer:
                 per_bucket[str(b)] = {"p50_ms": round(s["p50"], 3),
                                       "p99_ms": round(s["p99"], 3),
                                       "requests": int(s["count"])}
+        extra = {}
+        rejected = self._rejected_totals()
+        # rejections always ride a traced run's stats (zeroes included —
+        # "none rejected" is itself the signal); an untraced run only
+        # reports them once one actually happened
+        if self.tracer is not None or any(rejected.values()):
+            extra["rejected"] = rejected
+        if self.tracer is not None:
+            # the tracer drains on its own cadence (<= its interval
+            # stale here); the FINAL stats event runs after
+            # tracer.stop()'s synchronous drain, so it is exact
+            extra["decomposition"] = self._decomposition()
+            if self.slo_engine is not None:
+                snap = self.slo_engine.snapshot()
+                extra["slo"] = {
+                    k: snap.get(k) for k in
+                    ("deadline_miss_ratio", "deadline_misses",
+                     "attainment", "burn_rate", "arrival_rate_rps",
+                     "pad_waste", "queue_wait_frac")}
+                extra["slo"]["p99_target_ms"] = \
+                    (snap.get("objectives") or {}).get("p99_ms")
         self.hub.event(
             "serve_stats", tier=self.tier, final=final,
             requests=self._completed,
@@ -263,4 +399,4 @@ class PolicyServer:
             queue_depth=int(self.hub.get_gauge("serve_queue_depth") or 0),
             occupancy={str(b): n for b, n in
                        sorted(self._occupancy.items())},
-            buckets=per_bucket)
+            buckets=per_bucket, **extra)

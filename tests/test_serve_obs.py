@@ -1,0 +1,601 @@
+"""Serving-observability tests (gsc_tpu.obs.slo + the batcher/server
+tracing hooks): SLO-engine arithmetic against hand-computed cases,
+span-decomposition identities, rejection/queue-depth visibility, the
+live /metrics endpoint under concurrent submit load, trace-validator
+acceptance of the serve-request track, bench_diff slo-band verdicts in
+both directions, and the tracing-off bit-parity + no-host-sync
+contracts on the flush path.
+
+Most tests drive a raw :class:`MicroBatcher` (or a stub-policy
+:class:`PolicyServer`) with a numpy backend — no jax compile anywhere —
+so the whole group is tier-1 fast."""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gsc_tpu.obs import (ListSink, MetricsEndpoint, MetricsHub, ServeTracer,
+                         SLOEngine, SLOObjectives, parse_slo_spec)
+from gsc_tpu.obs.trace import TRACE_TRACKS, build_trace, validate_trace
+from gsc_tpu.serve import (MicroBatcher, ObsTemplate, PolicyServer,
+                           ServeError)
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+pytestmark = pytest.mark.serve_obs
+
+ANSWER = np.arange(2, dtype=np.float32)
+
+
+class StubPolicy:
+    """Duck-typed fallback tier: a fixed numpy answer per request — the
+    full batcher/tracer/SLO path with zero jax involvement."""
+
+    def __init__(self, leaf_dim=3):
+        self.template = ObsTemplate(np.zeros(leaf_dim, np.float32))
+
+    def run_batch(self, leaves, n_real, bucket):
+        return np.tile(ANSWER[None, :], (bucket, 1))
+
+
+def _obs():
+    return np.zeros(3, np.float32)
+
+
+def _traced_batcher(hub, sample=1, buckets=(1, 4), deadline_ms=5.0,
+                    slo="10", run_batch=None, **kw):
+    tracer = ServeTracer(hub=hub, sample=sample)
+    tracer.bind_engine(SLOEngine(deadline_ms=deadline_ms,
+                                 objectives=parse_slo_spec(slo), hub=hub))
+    tracer.start()
+    mb = MicroBatcher(run_batch or StubPolicy().run_batch,
+                      ObsTemplate(_obs()), buckets=buckets,
+                      deadline_ms=deadline_ms, hub=hub, tracer=tracer,
+                      **kw).start()
+    return mb, tracer
+
+
+# ------------------------------------------------------------- SLO engine
+def test_slo_engine_hand_computed_attainment_and_burn():
+    """10 requests against a 10 ms objective at target 0.99: 8 hits + 2
+    violations -> attainment 0.8, burn (1-0.8)/(1-0.99) = 20x; deadline
+    5 ms -> 2 misses -> miss ratio 0.2."""
+    eng = SLOEngine(deadline_ms=5.0, objectives=parse_slo_spec("10"))
+    for lat in [4.0] * 8 + [20.0] * 2:
+        eng.record_request(lat, bucket=1)
+    snap = eng.snapshot()
+    assert snap["attainment"] == 0.8
+    assert abs(snap["burn_rate"] - 20.0) < 1e-9
+    assert snap["deadline_miss_ratio"] == 0.2
+    assert snap["deadline_misses"] == 2 and snap["requests"] == 10
+
+
+def test_slo_engine_per_bucket_objective_overrides_overall():
+    """Spec "10,4:50": a 30 ms request in bucket 4 meets ITS objective
+    (50) while the same latency in bucket 1 violates the overall 10."""
+    eng = SLOEngine(deadline_ms=100.0, objectives=parse_slo_spec("10,4:50"),
+                    hub=None)
+    eng.record_request(30.0, bucket=4)
+    eng.record_request(30.0, bucket=1)
+    snap = eng.snapshot()
+    assert snap["attainment"] == 0.5
+    assert snap["per_bucket"]["4"]["attainment"] == 1.0
+    assert snap["per_bucket"]["4"]["objective_ms"] == 50.0
+    assert snap["per_bucket"]["1"]["attainment"] == 0.0
+    assert snap["per_bucket"]["1"]["objective_ms"] == 10.0
+    # deadline generous: no misses either way
+    assert snap["deadline_miss_ratio"] == 0.0
+
+
+def test_slo_engine_no_objective_tracks_misses_but_not_attainment():
+    eng = SLOEngine(deadline_ms=5.0)     # objectives off (the default)
+    eng.record_request(20.0, bucket=1)
+    snap = eng.snapshot()
+    assert snap["attainment"] is None and snap["burn_rate"] is None
+    assert snap["deadline_miss_ratio"] == 1.0
+
+
+def test_slo_engine_pad_waste_and_arrival_rate():
+    eng = SLOEngine(deadline_ms=5.0)
+    eng.record_flush(n_real=1, bucket=4)     # 0.75 wasted
+    eng.record_flush(n_real=4, bucket=4)     # 0.0 wasted
+    # 10 ms inter-arrival gaps -> EWMA converges onto 100 rps exactly
+    for i in range(50):
+        eng.note_arrival(100.0 + 0.01 * i)
+    snap = eng.snapshot()
+    assert snap["pad_waste"] == 0.375
+    assert snap["per_bucket"]["4"]["pad_waste"] == 0.375
+    assert abs(snap["arrival_rate_rps"] - 100.0) < 1.0
+
+
+def test_parse_slo_spec_grammar():
+    obj = parse_slo_spec("25")
+    assert obj.p99_ms == 25.0 and not obj.per_bucket
+    obj = parse_slo_spec("25,4:40,8:60")
+    assert obj.p99_ms == 25.0
+    assert obj.per_bucket == {4: 40.0, 8: 60.0}
+    assert obj.objective_for(8) == 60.0 and obj.objective_for(2) == 25.0
+    assert parse_slo_spec("4:40").p99_ms is None
+    for bad in ("", "abc", "25,30", "4:", "0", "4:-1", "4:40,4:50"):
+        with pytest.raises(ValueError):
+            parse_slo_spec(bad)
+
+
+# --------------------------------------------------------- span decomposition
+def test_span_decomposition_sums_to_recorded_latency():
+    """queue-wait + batch-wait + device == the serve_latency_ms the
+    batcher recorded for the same request (shared timestamps, so the
+    identity is exact up to float addition); every component and the
+    fan-out tail are non-negative."""
+    hub = MetricsHub(tags={"run": "spans"})
+    sink = ListSink()
+    hub.add_sink(sink)
+    mb, tracer = _traced_batcher(hub, sample=1, deadline_ms=20.0)
+    futs = [mb.submit(_obs()) for _ in range(4)]
+    for f in futs:
+        np.testing.assert_array_equal(f.result(30), ANSWER)
+    mb.submit(_obs()).result(30)      # lone request: deadline flush
+    mb.stop()
+    tracer.stop()
+    spans = sink.of_kind("serve_request_span")
+    assert len(spans) == 5            # sample=1 -> every request
+    for s in spans:
+        assert s["queue_wait_ms"] >= 0 and s["batch_wait_ms"] >= 0
+        assert s["device_ms"] >= 0 and s["fanout_ms"] >= 0
+        total = s["queue_wait_ms"] + s["batch_wait_ms"] + s["device_ms"]
+        assert abs(total - s["latency_ms"]) < 1e-2, s
+    lat = hub.histogram_summary("serve_latency_ms")
+    assert lat["count"] == 5
+    # the recorded end-to-end histogram and the span latencies agree
+    assert abs(max(s["latency_ms"] for s in spans) - lat["max"]) < 1e-2
+    # decomposition histograms landed per bucket too
+    assert hub.histogram_summary("serve_queue_wait_ms", bucket=4)["count"] \
+        == 4
+    flushes = sink.of_kind("serve_flush")
+    assert len(flushes) == 2
+    by_bucket = {f["bucket"]: f for f in flushes}
+    assert by_bucket[4]["n_real"] == 4 and by_bucket[4]["pad_fraction"] == 0
+    assert by_bucket[1]["n_real"] == 1
+    # span events reference the flush that answered them
+    assert {s["flush_id"] for s in spans} == \
+        {f["flush_id"] for f in flushes}
+
+
+def test_head_sampling_records_every_nth_request():
+    hub = MetricsHub()
+    sink = ListSink()
+    hub.add_sink(sink)
+    mb, tracer = _traced_batcher(hub, sample=3, buckets=(1,),
+                                 deadline_ms=0.5)
+    for _ in range(9):
+        mb.submit(_obs()).result(30)
+    mb.stop()
+    tracer.stop()
+    spans = sink.of_kind("serve_request_span")
+    assert [s["trace_id"] for s in spans] == [0, 3, 6]
+    # flush-level spans are ALWAYS recorded, sampling or not
+    assert len(sink.of_kind("serve_flush")) == 9
+
+
+# ------------------------------------------------- rejections + queue depth
+def test_rejections_are_counted_before_the_error_reaches_the_caller():
+    hub = MetricsHub()
+    t = ObsTemplate(_obs())
+    stub = StubPolicy()
+    tracer = ServeTracer(hub=hub, sample=0)
+    engine = SLOEngine(deadline_ms=5.0, hub=hub)
+    tracer.bind_engine(engine)
+    mb = MicroBatcher(stub.run_batch, t, buckets=(1,), max_queue=1,
+                      hub=hub, tracer=tracer)    # consumer NOT started
+    mb.submit(_obs())
+    with pytest.raises(ServeError, match="queue full"):
+        mb.submit(_obs())
+    assert hub.get_counter("serve_rejected_total", reason="queue_full") == 1
+    mb._stopping = True
+    with pytest.raises(ServeError, match="stopping"):
+        mb.submit(_obs())
+    assert hub.get_counter("serve_rejected_total", reason="stopping") == 1
+    tracer.drain_pending()
+    assert engine.snapshot()["rejected"] == {"queue_full": 1,
+                                             "stopping": 1}
+
+
+def test_queue_depth_sampled_on_submit_not_only_at_flush():
+    """The gauge used to be written only inside _flush, so it read stale
+    between flushes and while idle; submit now samples it too."""
+    hub = MetricsHub()
+    mb = MicroBatcher(StubPolicy().run_batch, ObsTemplate(_obs()),
+                      buckets=(8,), hub=hub)     # consumer NOT started
+    assert hub.get_gauge("serve_queue_depth") is None
+    mb.submit(_obs())
+    assert hub.get_gauge("serve_queue_depth") == 1.0
+    mb.submit(_obs())
+    assert hub.get_gauge("serve_queue_depth") == 2.0
+
+
+def test_live_queue_depth_probe_in_snapshot():
+    """PolicyServer registers a live probe: a hub snapshot taken at any
+    point reads the CURRENT depth, and drop_live_gauge retires it."""
+    hub = MetricsHub()
+    srv = PolicyServer(fallback=StubPolicy(), buckets=(1,),
+                       deadline_ms=1.0, hub=hub).start()
+    try:
+        assert hub.snapshot().get("gsc_serve_queue_depth") == 0.0
+    finally:
+        srv.close()
+    # after close the probe is dropped and the final static gauge holds
+    assert hub.snapshot().get("gsc_serve_queue_depth") == 0.0
+    assert ("serve_queue_depth", ()) not in hub._live_gauges
+
+
+# -------------------------------------------- endpoint under live serving
+def test_metrics_endpoint_under_concurrent_submit_load():
+    """Concurrent submitters + /metrics scrapes mid-run: every scrape
+    parses, SLO gauges + rejection counters appear once drained, and an
+    idle-state scrape equals the hub snapshot exactly."""
+    hub = MetricsHub(tags={"run": "live"})
+    sink = ListSink()
+    hub.add_sink(sink)
+
+    class SlowStub(StubPolicy):
+        def run_batch(self, leaves, n_real, bucket):
+            time.sleep(0.002)     # lets the mid-run scrape see a queue
+            return super().run_batch(leaves, n_real, bucket)
+
+    tracer = ServeTracer(hub=hub, sample=0, drain_interval_s=0.01)
+    srv = PolicyServer(fallback=SlowStub(), buckets=(1, 4),
+                       deadline_ms=1.0, hub=hub, tracer=tracer,
+                       slo=parse_slo_spec("5"), max_queue=4096).start()
+    ep = MetricsEndpoint(hub, port=0).start()
+    errors = []
+
+    def client(n):
+        for _ in range(n):
+            try:
+                np.testing.assert_array_equal(
+                    srv.submit(_obs()).result(30), ANSWER)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(10,), daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    mid = urllib.request.urlopen(ep.url, timeout=10).read().decode()
+    for line in mid.strip().splitlines():    # every line parses
+        name, value = line.rsplit(" ", 1)
+        float(value)
+    for t in threads:
+        t.join()
+    # force one rejection so the counter is scrapeable
+    srv.batcher._stopping = True
+    with pytest.raises(ServeError):
+        srv.submit(_obs())
+    srv.batcher._stopping = False
+    tracer.drain_pending()
+    body = urllib.request.urlopen(ep.url, timeout=10).read().decode()
+    parsed = {}
+    for line in body.strip().splitlines():
+        name, value = line.rsplit(" ", 1)
+        parsed[name] = float(value)
+    assert not errors, errors
+    assert parsed['gsc_slo_deadline_miss_ratio{run="live"}'] >= 0.0
+    assert 'gsc_slo_attainment{run="live"}' in parsed
+    assert 'gsc_slo_burn_rate{run="live"}' in parsed
+    assert parsed['gsc_serve_rejected_total{reason="stopping",run="live"}'] \
+        == 1.0
+    assert parsed['gsc_serve_requests_total{run="live"}'] == 40.0
+    # idle-state parity: scrape == snapshot, series for series
+    snap = {k: float(v) for k, v in hub.snapshot().items()}
+    rescrape = {}
+    for line in urllib.request.urlopen(
+            ep.url, timeout=10).read().decode().strip().splitlines():
+        name, value = line.rsplit(" ", 1)
+        rescrape[name] = float(value)
+    assert rescrape == snap
+    ep.stop()
+    srv.close()
+
+
+# ----------------------------------------------------- trace-track contract
+def test_trace_validator_accepts_serve_request_track_with_flows():
+    events = [
+        {"event": "run_start", "ts": 100.0, "run": "t"},
+        {"event": "serve_flush", "ts": 100.010, "flush_id": 0,
+         "bucket": 4, "n_real": 3, "pad_fraction": 0.25,
+         "device_ms": 1.5, "queue_depth": 0},
+        {"event": "serve_request_span", "ts": 100.004, "trace_id": 7,
+         "flush_id": 0, "bucket": 4, "queue_wait_ms": 1.0,
+         "batch_wait_ms": 5.0, "device_ms": 1.5, "fanout_ms": 0.1,
+         "latency_ms": 7.5, "deadline_miss": True},
+    ]
+    trace = build_trace(events)
+    assert validate_trace(trace) == []
+    evs = trace["traceEvents"]
+    req = [e for e in evs if e.get("ph") == "X"
+           and e["tid"] == TRACE_TRACKS["serve_request"]]
+    fl = [e for e in evs if e.get("ph") == "X"
+          and e["tid"] == TRACE_TRACKS["serve"]]
+    assert len(req) == 1 and len(fl) == 1
+    assert req[0]["args"]["queue_wait_ms"] == 1.0
+    assert req[0]["dur"] == 7600.0      # (latency + fanout) in us
+    assert fl[0]["dur"] == 1500.0
+    starts = [e for e in evs if e.get("ph") == "s"]
+    ends = [e for e in evs if e.get("ph") == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"]
+    # the arrow lands on the flush's dispatch timestamp
+    assert ends[0]["ts"] == fl[0]["ts"]
+
+
+def test_trace_span_without_matching_flush_emits_no_dangling_flow():
+    events = [
+        {"event": "run_start", "ts": 100.0, "run": "t"},
+        {"event": "serve_request_span", "ts": 100.004, "trace_id": 7,
+         "flush_id": 42, "bucket": 4, "queue_wait_ms": 1.0,
+         "batch_wait_ms": 5.0, "device_ms": 1.5, "fanout_ms": 0.1,
+         "latency_ms": 7.5},
+    ]
+    trace = build_trace(events)
+    assert validate_trace(trace) == []
+    assert not [e for e in trace["traceEvents"]
+                if e.get("ph") in ("s", "f")]
+
+
+def test_real_stream_exports_valid_trace(tmp_path):
+    """A real batcher run's event stream (through a JSONL sink on disk)
+    builds a validator-clean trace with flow-linked request spans."""
+    from gsc_tpu.obs import JsonlSink
+    from gsc_tpu.obs.trace import read_events
+
+    hub = MetricsHub(tags={"run": "e2e"})
+    hub.add_sink(JsonlSink(str(tmp_path / "events.jsonl")))
+    hub.event("run_start", mode="serve")
+    mb, tracer = _traced_batcher(hub, sample=1, deadline_ms=2.0)
+    for _ in range(6):
+        mb.submit(_obs()).result(30)
+    mb.stop()
+    tracer.stop()
+    hub.event("run_end", status="ok")
+    trace = build_trace(read_events(str(tmp_path)))
+    assert validate_trace(trace) == []
+    req = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+           and e["tid"] == TRACE_TRACKS["serve_request"]]
+    assert len(req) == 6
+    assert [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+
+
+# ------------------------------------------------------- bench_diff bands
+def test_bench_diff_slo_bands_both_directions(tmp_path):
+    import bench_diff
+
+    base = {"name": "slo_base", "status": "ok", "kind": "slo",
+            "metrics": {"slo_deadline_miss_ratio": 0.05,
+                        "slo_pad_waste": 0.2, "slo_queue_wait_frac": 0.3,
+                        "slo_burn_rate": 1.0, "slo_attainment": 0.99}}
+    worse = {"name": "slo_worse", "status": "ok", "kind": "slo",
+             "metrics": {"slo_deadline_miss_ratio": 0.4,
+                         "slo_pad_waste": 0.6, "slo_queue_wait_frac": 0.7,
+                         "slo_burn_rate": 4.0, "slo_attainment": 0.5}}
+    d = bench_diff.diff_rows(worse, base)
+    assert d["verdict"] == "regression"
+    assert set(d["regressions"]) == {
+        "slo_deadline_miss_ratio", "slo_pad_waste", "slo_queue_wait_frac",
+        "slo_burn_rate", "slo_attainment"}
+    d = bench_diff.diff_rows(base, worse)
+    assert d["verdict"] == "ok" and not d["regressions"]
+    # absolute floors: near-zero jitter is noise, not a regression
+    d = bench_diff.diff_rows(
+        {"name": "a", "metrics": {"slo_deadline_miss_ratio": 0.015}},
+        {"name": "b", "metrics": {"slo_deadline_miss_ratio": 0.0}})
+    assert d["verdict"] == "ok"
+    # a real slo.json document ingests as a keyed slo_ row
+    doc = {"schema_version": 1, "run": "runx", "tier": "spr",
+           "deadline_ms": 5.0, "requests": 10,
+           "deadline_miss_ratio": 0.1, "pad_waste": 0.25,
+           "queue_wait_frac": 0.4, "burn_rate": 2.0, "attainment": 0.98,
+           "arrival_rate_rps": 500.0,
+           "p50_latency_ms": 1.0, "p99_latency_ms": 4.0}
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps(doc))
+    row = bench_diff.extract_row(str(p))
+    assert row["name"] == "slo_runx" and row["kind"] == "slo"
+    assert row["metrics"]["slo_burn_rate"] == 2.0
+    assert row["metrics"]["p99_ms"] == 4.0
+    # arrival rate must NOT become a gated `_rps` metric
+    assert not any("arrival" in m for m in row["metrics"])
+
+
+# --------------------------------------------- off-switch + sync contracts
+def test_answers_and_latency_bit_identical_with_tracing_off():
+    """tracer=None is the historic path: same answers, same latency
+    series shape, and zero span events/SLO artifacts."""
+    sink_on, sink_off = ListSink(), ListSink()
+    hub_on = MetricsHub()
+    hub_on.add_sink(sink_on)
+    hub_off = MetricsHub()
+    hub_off.add_sink(sink_off)
+    mb_on, tracer = _traced_batcher(hub_on, sample=1)
+    mb_off = MicroBatcher(StubPolicy().run_batch, ObsTemplate(_obs()),
+                          buckets=(1, 4), deadline_ms=5.0,
+                          hub=hub_off).start()
+    outs_on = [mb_on.submit(_obs()).result(30) for _ in range(3)]
+    outs_off = [mb_off.submit(_obs()).result(30) for _ in range(3)]
+    mb_on.stop()
+    tracer.stop()
+    mb_off.stop()
+    for a, b in zip(outs_on, outs_off):
+        np.testing.assert_array_equal(a, b)
+    assert hub_on.histogram_summary("serve_latency_ms")["count"] == \
+        hub_off.histogram_summary("serve_latency_ms")["count"] == 3
+    assert sink_on.of_kind("serve_request_span")
+    assert not sink_off.of_kind("serve_request_span")
+    assert not sink_off.of_kind("serve_flush")
+    # tracing off also means no decomposition histograms
+    assert hub_off.histogram_summary("serve_queue_wait_ms") is None
+
+
+def test_flush_path_and_span_drain_add_no_host_syncs():
+    """The whole serve interaction — submit, flush, span drain, SLO
+    update, event emission — under the host-sync tripwire: the backend
+    is pure numpy, so any device->host sync would come from the new
+    tracing/SLO code and raise."""
+    from gsc_tpu.analysis.sentinels import no_host_sync
+
+    hub = MetricsHub(tags={"run": "sync"})
+    sink = ListSink()
+    hub.add_sink(sink)
+    with no_host_sync("serve flush path with tracing ON"):
+        mb, tracer = _traced_batcher(hub, sample=1, deadline_ms=2.0)
+        for _ in range(5):
+            mb.submit(_obs()).result(30)
+        mb.stop()
+        tracer.stop()
+    assert sink.of_kind("serve_request_span")
+    assert tracer.engine.snapshot()["requests"] == 5
+
+
+def test_slo_json_written_at_server_close(tmp_path):
+    slo_path = str(tmp_path / "slo.json")
+    hub = MetricsHub(tags={"run": "closer"})
+    tracer = ServeTracer(hub=hub, sample=0)
+    srv = PolicyServer(fallback=StubPolicy(), buckets=(1, 2),
+                       deadline_ms=1.0, hub=hub, tracer=tracer,
+                       slo=SLOObjectives(p99_ms=10.0),
+                       slo_path=slo_path).start()
+    for _ in range(4):
+        srv.submit_sync(_obs(), timeout=30)
+    srv.close()
+    doc = json.load(open(slo_path))
+    assert doc["schema_version"] == 1 and doc["tier"] == "spr"
+    assert doc["requests"] == 4 and doc["run"] == "closer"
+    assert doc["objectives"]["p99_ms"] == 10.0
+    assert doc["deadline_miss_ratio"] is not None
+    assert doc["attainment"] is not None and doc["burn_rate"] is not None
+    assert doc["pad_waste"] is not None
+    assert doc["decomposition_ms"], doc
+    # the summary the CLI prints matches the document's core fields
+    s = srv.slo_summary()
+    assert s["deadline_miss_ratio"] == doc["deadline_miss_ratio"]
+    assert s["p99_target_ms"] == 10.0
+
+
+def test_serve_stats_carries_slo_decomposition_and_report_renders(tmp_path):
+    """serve_stats -> events.jsonl -> obs_report: the serving section
+    surfaces the SLO snapshot, decomposition table and rejections."""
+    from obs_report import load_events, summarize
+
+    from gsc_tpu.obs import RunObserver
+
+    rec = RunObserver(str(tmp_path / "run"))
+    rec.start(meta={"mode": "serve", "tier": "spr"})
+    tracer = ServeTracer(hub=rec.hub, sample=2)
+    srv = PolicyServer(fallback=StubPolicy(), buckets=(1, 2),
+                       deadline_ms=1.0, hub=rec.hub, tracer=tracer,
+                       slo=parse_slo_spec("50"),
+                       slo_path=rec.slo_path).start()
+    for _ in range(4):
+        srv.submit_sync(_obs(), timeout=30)
+    # one visible rejection
+    srv.batcher._stopping = True
+    with pytest.raises(ServeError):
+        srv.submit(_obs())
+    srv.batcher._stopping = False
+    srv.close()
+    rec.close(status="ok")
+    sv = summarize(load_events(str(tmp_path / "run")))["serving"]
+    assert sv["slo"] is not None
+    assert sv["slo"]["p99_target_ms"] == 50.0
+    assert sv["slo"]["attainment"] is not None
+    assert sv["rejected"].get("stopping") == 1
+    assert sv["decomposition"], sv
+    first = next(iter(sv["decomposition"].values()))
+    assert {"queue_ms", "batch_ms", "device_ms"} <= set(first)
+    assert os.path.exists(rec.slo_path)
+
+
+def test_failed_device_calls_burn_the_slo_budget():
+    """A run_batch error must degrade attainment / miss ratio, not leave
+    the SLO engine reporting perfect health while clients see errors."""
+    hub = MetricsHub()
+    sink = ListSink()
+    hub.add_sink(sink)
+    calls = {"n": 0}
+
+    def flaky(leaves, k, bucket):
+        calls["n"] += 1
+        if calls["n"] % 2 == 0:
+            raise RuntimeError("injected device fault")
+        return np.tile(ANSWER[None, :], (bucket, 1))
+
+    mb, tracer = _traced_batcher(hub, sample=1, buckets=(1,),
+                                 deadline_ms=1000.0, slo="1000",
+                                 run_batch=flaky)
+    ok = err = 0
+    for _ in range(6):
+        try:
+            mb.submit(_obs()).result(30)
+            ok += 1
+        except ServeError:
+            err += 1
+    mb.stop()
+    tracer.stop()
+    assert ok == 3 and err == 3
+    snap = tracer.engine.snapshot()
+    # EVERY request is accounted: 3 answered + 3 errored
+    assert snap["requests"] == 6 and snap["errored_requests"] == 3
+    assert snap["deadline_misses"] == 3      # errored = missed
+    assert snap["deadline_miss_ratio"] == 0.5
+    assert snap["attainment"] == 0.5         # inf latency fails the 1000
+    assert snap["burn_rate"] > 0
+    # failed flushes still land as serve_flush slices, carrying the error
+    failed = [f for f in sink.of_kind("serve_flush") if f.get("error")]
+    assert len(failed) == 3
+    assert "injected device fault" in failed[0]["error"]
+    # but no request span pretends those requests completed
+    assert len(sink.of_kind("serve_request_span")) == 3
+
+
+def test_flows_never_cross_appended_runs():
+    """Two runs in one stream each restart flush ids at 0: a run-1 span
+    must not arrow into run-2's flush slice (and with run-1's flush
+    absent, no dangling flow at all)."""
+    events = [
+        {"event": "run_start", "ts": 100.0, "run": "r"},
+        # run 1: sampled span whose flush event was lost (rotation, torn
+        # tail) — flush_id 0 exists only in run 2
+        {"event": "serve_request_span", "ts": 100.001, "trace_id": 1,
+         "flush_id": 0, "bucket": 1, "queue_wait_ms": 0.1,
+         "batch_wait_ms": 0.1, "device_ms": 0.1, "fanout_ms": 0.0,
+         "latency_ms": 0.3},
+        {"event": "run_start", "ts": 200.0, "run": "r"},
+        {"event": "serve_flush", "ts": 200.005, "flush_id": 0,
+         "bucket": 1, "n_real": 1, "pad_fraction": 0.0,
+         "device_ms": 0.1},
+        {"event": "serve_request_span", "ts": 200.001, "trace_id": 1,
+         "flush_id": 0, "bucket": 1, "queue_wait_ms": 0.1,
+         "batch_wait_ms": 0.1, "device_ms": 0.1, "fanout_ms": 0.0,
+         "latency_ms": 0.3},
+    ]
+    trace = build_trace(events)
+    assert validate_trace(trace) == []
+    starts = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    # exactly ONE flow: run 2's span -> run 2's flush
+    assert len(starts) == 1
+    assert starts[0]["ts"] >= 100000.0   # run 2 territory (ts_us)
+
+
+def test_tracer_overflow_drops_oldest_and_counts():
+    hub = MetricsHub()
+    tracer = ServeTracer(hub=hub, sample=0, max_pending=2)
+    for i in range(5):
+        tracer.note_rejection("queue_full", float(i))
+    assert tracer.spans_dropped == 3
+    tracer.drain_pending()
+    assert hub.get_counter("serve_spans_dropped_total") == 3

@@ -83,6 +83,16 @@ METRIC_RULES: List[Tuple] = [
     ("auc_return", True, 0.25, 1.0),
     ("episodes_to_threshold", False, 0.25, 1.0),
     ("final_window_td_abs", False, 0.30, 0.05),
+    # serving SLO metrics (cli serve / PolicyServer slo summaries, banked
+    # per serve_bench leg and as per-run slo.json documents) — SERVE rows
+    # gate on serving QUALITY, not just rps/p99.  Ratios legitimately sit
+    # at/near zero (a healthy run has no deadline misses), so every band
+    # carries an absolute floor in ratio units.
+    ("slo_deadline_miss_ratio", False, 0.25, 0.02),
+    ("slo_pad_waste", False, 0.25, 0.05),
+    ("slo_queue_wait_frac", False, 0.30, 0.05),
+    ("slo_burn_rate", False, 0.25, 0.25),
+    ("slo_attainment", True, 0.05, 0.02),
 ]
 
 # filename patterns `ingest --scan` picks up.  perf.json ledgers and
@@ -90,7 +100,8 @@ METRIC_RULES: List[Tuple] = [
 # at results/<id>/<timestamp>/ (utils.experiment.setup_result_dir
 # layout), arbitrarily deep below the scan root.
 SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
-                 "MIXTOPO_r*.json", "**/perf.json", "**/curves.json")
+                 "MIXTOPO_r*.json", "**/perf.json", "**/curves.json",
+                 "**/slo.json")
 
 
 def metric_rule(name: str) -> Optional[Tuple[bool, float, float]]:
@@ -156,6 +167,21 @@ def _multichip_row(d: Dict) -> Dict:
             "metrics": metrics, "context": {"mode": d.get("mode")}}
 
 
+# the SLO-summary keys that become gated `slo_*` metrics on serve rows
+# (arrival rate / p99 target are context, not gates — and an `_rps`
+# suffix would wrongly match the throughput band)
+_SLO_GATED_KEYS = ("deadline_miss_ratio", "pad_waste", "queue_wait_frac",
+                   "burn_rate", "attainment")
+
+
+def _slo_metrics(slo: Dict, prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k in _SLO_GATED_KEYS:
+        if _num((slo or {}).get(k)) is not None:
+            out[f"{prefix}slo_{k}"] = float(slo[k])
+    return out
+
+
 def _serve_row(d: Dict) -> Dict:
     metrics: Dict[str, float] = {}
     for k in ("cold_start_s", "cache_hit_start_s"):
@@ -166,14 +192,37 @@ def _serve_row(d: Dict) -> Dict:
         for k in ("rps", "p50_ms", "p99_ms"):
             if _num((leg or {}).get(k)) is not None:
                 metrics[f"{leg_name}_{k}"] = float(leg[k])
+        # per-leg SLO summary (serve_bench banks the cli serve `slo`
+        # block): deadline-miss ratio, pad waste, queue-wait fraction,
+        # burn rate, attainment gate under the slo_* bands
+        metrics.update(_slo_metrics((leg or {}).get("slo"),
+                                    prefix=f"{leg_name}_"))
     # flat single-run serve JSON (cli serve output) has rps/p99 top-level
     for k in ("rps", "p50_ms", "p99_ms"):
         if _num(d.get(k)) is not None:
             metrics[k] = float(d[k])
+    metrics.update(_slo_metrics(d.get("slo")))
     return {"kind": "serve", "status": d.get("status", "ok"),
             "metrics": metrics,
             "context": {k: d.get(k) for k in ("tier", "buckets", "platform")
                         if k in d}}
+
+
+def _slo_row(d: Dict) -> Dict:
+    """A per-run slo.json document (gsc_tpu.obs.slo, written by
+    PolicyServer.close): the same gated slo_* axes as a serve row, plus
+    the run's latency percentiles."""
+    metrics = _slo_metrics(d)
+    for k in ("p50_latency_ms", "p99_latency_ms"):
+        if _num(d.get(k)) is not None:
+            # suffix-normalize so the p50/p99 latency bands gate them
+            metrics[k.replace("_latency", "")] = float(d[k])
+    if _num(d.get("requests")) is not None:
+        metrics["requests"] = float(d["requests"])   # informational
+    return {"kind": "slo", "status": "ok", "metrics": metrics,
+            "context": {"run": d.get("run"), "tier": d.get("tier"),
+                        "slo_schema": d.get("schema_version"),
+                        "deadline_ms": d.get("deadline_ms")}}
 
 
 def _perf_row(d: Dict) -> Dict:
@@ -240,11 +289,13 @@ def extract_row(path: str) -> Optional[Dict]:
         row = _perf_row(d)
     elif "schema_version" in d and "series" in d and "summary" in d:
         row = _curves_row(d)
+    elif "schema_version" in d and "deadline_miss_ratio" in d:
+        row = _slo_row(d)
     else:
         return None
     base = os.path.basename(path)
     name = os.path.splitext(base)[0]
-    if name in ("perf", "curves"):
+    if name in ("perf", "curves", "slo"):
         # per-run artifacts share their filename; key by run dir (or the
         # document's recorded run id) so two runs never collide
         run = (row.get("context") or {}).get("run")
@@ -419,6 +470,13 @@ def selftest() -> int:
             "entries": {"episode_step": {
                 "available": True, "flops": 6.6e6, "bytes_accessed": 6.7e6,
                 "fusions": 718, "mfu": 1e-4, "wall_s_mean": 1.3}}})
+        slo = dump("slo.json", {
+            "schema_version": 1, "run": "sloself", "tier": "learned",
+            "deadline_ms": 5.0, "requests": 200,
+            "deadline_miss_ratio": 0.05, "pad_waste": 0.2,
+            "queue_wait_frac": 0.3, "burn_rate": 1.0,
+            "attainment": 0.99, "arrival_rate_rps": 900.0,
+            "p50_latency_ms": 1.2, "p99_latency_ms": 6.0})
         curves = dump("curves.json", {
             "schema_version": 1, "run": "curveself", "episodes": 12,
             "series": {"episode": list(range(12))}, "per_topology": {},
@@ -427,15 +485,18 @@ def selftest() -> int:
                         "episodes_to_threshold": 8,
                         "final_window_td_abs": 0.4}})
         traj = os.path.join(tmp, "BENCH_TRAJECTORY.json")
-        doc = ingest([good, slow, wrapper, perf, curves], traj)
+        doc = ingest([good, slow, wrapper, perf, curves, slo], traj)
         assert set(doc["rows"]) == {"BENCH_r98", "BENCH_r99", "BENCH_r97",
-                                    "perf_selftest", "curves_curveself"}, \
+                                    "perf_selftest", "curves_curveself",
+                                    "slo_sloself"}, \
             doc["rows"].keys()
         assert doc["rows"]["BENCH_r97"]["status"] == "failed"
         assert doc["rows"]["perf_selftest"]["metrics"][
             "episode_step_fusions"] == 718.0
         assert doc["rows"]["curves_curveself"]["metrics"][
             "final_window_return"] == 20.0
+        assert doc["rows"]["slo_sloself"]["metrics"][
+            "slo_deadline_miss_ratio"] == 0.05
 
         # per-run ledgers live at results/<id>/<timestamp>/perf.json —
         # `--scan` must find them recursively
@@ -505,6 +566,60 @@ def selftest() -> int:
                        "metrics": {"final_window_return": 0.01}})
         assert d["verdict"] == "regression", d
 
+        # serving SLO bands: a run that misses more deadlines, wastes
+        # more padding, queues longer and burns budget faster regresses
+        # on every slo axis; attainment collapse flags too
+        srow = {**doc["rows"]["slo_sloself"], "name": "slo_base"}
+        d = diff_rows(srow, srow)
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        worse_slo = {"name": "slo_bad", "status": "ok", "kind": "slo",
+                     "metrics": {"slo_deadline_miss_ratio": 0.4,
+                                 "slo_pad_waste": 0.6,
+                                 "slo_queue_wait_frac": 0.7,
+                                 "slo_burn_rate": 4.0,
+                                 "slo_attainment": 0.6}}
+        d = diff_rows(worse_slo, srow)
+        assert d["verdict"] == "regression", d
+        for m in ("slo_deadline_miss_ratio", "slo_pad_waste",
+                  "slo_queue_wait_frac", "slo_burn_rate",
+                  "slo_attainment"):
+            assert m in d["regressions"], (m, d["regressions"])
+        # the reverse direction improves, never flags
+        d = diff_rows(srow, worse_slo)
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        # absolute floors: near-zero miss-ratio jitter is noise, not a
+        # regression (relative band alone would be ~0)
+        d = diff_rows({"name": "j1",
+                       "metrics": {"slo_deadline_miss_ratio": 0.015}},
+                      {"name": "j0",
+                       "metrics": {"slo_deadline_miss_ratio": 0.0}})
+        assert d["verdict"] == "ok", d
+        # serve artifacts with per-leg slo blocks gate by leg
+        serve_art = dump("SERVE_r96.json", {
+            "metric": "serve_requests_per_sec",
+            "cold_start_s": 0.5, "cache_hit_start_s": 0.2,
+            "legs": {"warm": {"rps": 5000.0, "p50_ms": 1.0,
+                              "p99_ms": 4.0,
+                              "slo": {"deadline_miss_ratio": 0.1,
+                                      "pad_waste": 0.25,
+                                      "queue_wait_frac": 0.4,
+                                      "burn_rate": 2.0,
+                                      "attainment": 0.95,
+                                      "arrival_rate_rps": 5100.0}}}})
+        srow2 = extract_row(serve_art)
+        assert srow2["metrics"]["warm_slo_deadline_miss_ratio"] == 0.1, \
+            srow2["metrics"]
+        # arrival rate stays ungated context (an `_rps` suffix would
+        # wrongly ride the throughput band)
+        assert not any("arrival" in m for m in srow2["metrics"]), \
+            srow2["metrics"]
+        worse_leg = dict(srow2, name="serve_bad",
+                         metrics={**srow2["metrics"],
+                                  "warm_slo_deadline_miss_ratio": 0.5})
+        d = diff_rows(worse_leg, {**srow2, "name": "serve_base"})
+        assert d["verdict"] == "regression" \
+            and "warm_slo_deadline_miss_ratio" in d["regressions"], d
+
         # a widened tolerance declassifies a small regression
         d = diff_rows({"name": "a", "metrics": {"x_mfu": 0.9}},
                       {"name": "b", "metrics": {"x_mfu": 1.0}},
@@ -561,7 +676,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ing.add_argument("paths", nargs="*", help="artifact files")
     ing.add_argument("--scan", default=None,
                      help="also glob BENCH_r*/MULTICHIP_r*/SERVE_r*/"
-                          "perf.json/curves.json under this directory")
+                          "perf.json/curves.json/slo.json under this "
+                          "directory")
     ing.add_argument("--out", default="BENCH_TRAJECTORY.json")
     dif = sub.add_parser("diff", help="current vs named baseline, exit "
                                       "nonzero on regression")

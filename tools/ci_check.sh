@@ -69,6 +69,16 @@ echo "== learnobs smoke (learn ledger -> curves.json + /metrics + bench_diff gat
 # tools/learnobs_smoke.py asserts all of it
 env JAX_PLATFORMS=cpu python tools/learnobs_smoke.py
 
+echo "== serveobs smoke (request tracing + SLO engine -> slo.json + trace + gate) =="
+# a tiny SPR-tier serve run with --trace-sample 1 and a deliberately low
+# --slo-p99-ms must write a complete slo.json (attainment/burn/deadline-
+# miss/pad-waste/decomposition), leave sampled request spans that export
+# as a VALID trace with request->flush flow arrows, scrape cleanly over
+# /metrics (live queue-depth probe current), and gate through bench_diff
+# (self-compare rc 0, injected SLO regression rc 1) —
+# tools/serveobs_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/serveobs_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -39,8 +39,12 @@ does by default), prints:
   peaks + param norms, replay fill;
 - a serving section for ``cli serve`` runs, from the ``serve_start`` /
   ``serve_stats`` events (gsc_tpu.serve.PolicyServer): tier, requests/s,
-  p50/p99 latency overall and per batch bucket, bucket occupancy, and
-  per-bucket startup (artifact-cache hit + prepare wall).
+  p50/p99 latency overall and per batch bucket, bucket occupancy,
+  per-bucket startup (artifact-cache hit + prepare wall), the
+  latency-decomposition table (queue-wait / batch-formation wait /
+  device wall / fan-out mean per bucket, from the request-path tracer),
+  the SLO verdict (attainment, error-budget burn rate, deadline-miss
+  ratio, arrival-rate EWMA), and the rejection + pad-waste accounting.
 
 ``--json`` emits the same summary as one machine-readable JSON object.
 ``--selftest`` synthesizes a stream (including a stall and a leak),
@@ -380,6 +384,12 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
             "startup_s": (serve_start or {}).get("startup_s"),
             "bucket_prepare": (serve_start or {}).get("bucket_prepare")
             or {},
+            # request-path tracing + SLO engine (gsc_tpu.obs.slo): the
+            # final serve_stats carries the per-bucket latency split,
+            # the SLO snapshot and the rejection totals when tracing ran
+            "decomposition": last.get("decomposition") or {},
+            "slo": last.get("slo"),
+            "rejected": last.get("rejected") or {},
         }
     return {
         "episodes": len(episodes),
@@ -528,6 +538,32 @@ def render_text(summary: Dict, out=sys.stdout):
               f"p99 {lat.get('p99_ms', '-'):>8} ms   "
               f"cache_hit {str(prep.get('cache_hit', '-')):<5} "
               f"prepare {prep.get('prepare_s', '-')}s\n")
+        slo = sv.get("slo")
+        if slo:
+            w(f"  SLO: p99 target {_fmt(slo.get('p99_target_ms'), 1)} ms  "
+              f"attainment {_fmt(slo.get('attainment'), 1)}  "
+              f"budget burn {_fmt(slo.get('burn_rate'), 1)}x  "
+              f"deadline-miss {_fmt(slo.get('deadline_miss_ratio'), 1)}  "
+              f"arrival {_fmt(slo.get('arrival_rate_rps'), 1)} rps\n")
+            w(f"  pad waste {_fmt(slo.get('pad_waste'), 1)}  "
+              f"queue-wait fraction "
+              f"{_fmt(slo.get('queue_wait_frac'), 1)}\n")
+        if sv.get("rejected"):
+            rej = sv["rejected"]
+            w("  rejected: " + "  ".join(
+                f"{reason} {n}" for reason, n in sorted(rej.items()))
+              + "\n")
+        if sv.get("decomposition"):
+            w("  latency decomposition (ms mean per bucket: queue-wait /"
+              " batch-formation / device / fan-out):\n")
+            w(f"  {'bucket':>8} {'queue_ms':>10} {'batch_ms':>10} "
+              f"{'device_ms':>10} {'fanout_ms':>10}\n")
+            for b in sorted(sv["decomposition"], key=int):
+                row = sv["decomposition"][b]
+                w(f"  {b:>8} {_fmt(row.get('queue_ms'), 10)} "
+                  f"{_fmt(row.get('batch_ms'), 10)} "
+                  f"{_fmt(row.get('device_ms'), 10)} "
+                  f"{_fmt(row.get('fanout_ms'), 10)}\n")
     rows = summary["rows"]
     if rows:
         w("(*_ms columns are phase-wall deltas between consecutive "
@@ -786,6 +822,17 @@ def _synthetic_events(path: str, episodes: int = 5):
                                  "4": {"cache_hit": False,
                                        "prepare_s": 0.9}},
               "cache_dir": "/tmp/cache", "fingerprint": "abc"})
+        # request-path tracer spans: flush slices are always recorded,
+        # request spans head-sampled — the trace exporter (not this
+        # report) renders them; they must round-trip the reader unharmed
+        emit({"event": "serve_flush", "ts": base + 5.2, "run": "selftest",
+              "flush_id": 0, "bucket": 4, "n_real": 3,
+              "pad_fraction": 0.25, "device_ms": 1.5, "queue_depth": 2})
+        emit({"event": "serve_request_span", "ts": base + 5.1,
+              "run": "selftest", "trace_id": 0, "flush_id": 0,
+              "bucket": 4, "queue_wait_ms": 0.4, "batch_wait_ms": 3.1,
+              "device_ms": 1.5, "fanout_ms": 0.1, "latency_ms": 5.0,
+              "deadline_miss": False})
         emit({"event": "serve_stats", "ts": base + 6, "run": "selftest",
               "tier": "learned", "final": True, "requests": 200,
               "rps": 512.5, "p50_ms": 1.2, "p99_ms": 7.9, "mean_ms": 1.9,
@@ -794,7 +841,21 @@ def _synthetic_events(path: str, episodes: int = 5):
               "buckets": {"1": {"p50_ms": 0.9, "p99_ms": 2.0,
                                 "requests": 40},
                           "4": {"p50_ms": 1.3, "p99_ms": 7.9,
-                                "requests": 160}}})
+                                "requests": 160}},
+              # SLO engine + tracer extras (gsc_tpu.obs.slo): the final
+              # stats event folds in the decomposition, SLO snapshot
+              # and rejection totals — the report must surface all three
+              "decomposition": {"1": {"queue_ms": 0.2, "batch_ms": 5.0,
+                                      "device_ms": 0.8,
+                                      "fanout_ms": 0.05},
+                                "4": {"queue_ms": 0.9, "batch_ms": 2.1,
+                                      "device_ms": 1.5,
+                                      "fanout_ms": 0.12}},
+              "slo": {"p99_target_ms": 10.0, "attainment": 0.97,
+                      "burn_rate": 3.0, "deadline_miss_ratio": 0.12,
+                      "deadline_misses": 24, "arrival_rate_rps": 812.0,
+                      "pad_waste": 0.31, "queue_wait_frac": 0.22},
+              "rejected": {"queue_full": 3, "stopping": 0}})
         emit({"event": "run_end", "ts": base + episodes + 1,
               "run": "selftest", "status": "ok", "episodes": episodes})
 
@@ -884,6 +945,10 @@ def selftest() -> int:
         import io
         txt = io.StringIO()
         render_text(summary, out=txt)
+        assert "SLO: p99 target" in txt.getvalue() \
+            and "latency decomposition" in txt.getvalue() \
+            and "rejected: queue_full 3" in txt.getvalue(), \
+            "serving SLO/decomposition/rejection lines not rendered"
         assert "perf ledger: schema v1" in txt.getvalue(), \
             "perf schema-version header not rendered"
         assert "perf (device-cost ledger" in txt.getvalue() \
@@ -924,6 +989,18 @@ def selftest() -> int:
             and sv["bucket_prepare"]["4"]["cache_hit"] is False, \
             "per-bucket cache-hit pattern lost"
         assert sv["buckets"]["4"]["p99_ms"] == 7.9, sv
+        # SLO engine + tracer section: attainment/burn, rejection and
+        # pad-waste accounting, and the per-bucket latency split
+        assert sv["slo"]["attainment"] == 0.97 \
+            and sv["slo"]["burn_rate"] == 3.0 \
+            and sv["slo"]["deadline_miss_ratio"] == 0.12, \
+            "SLO snapshot not surfaced"
+        assert sv["rejected"] == {"queue_full": 3, "stopping": 0}, \
+            "rejection totals lost"
+        assert sv["slo"]["pad_waste"] == 0.31, sv["slo"]
+        assert sv["decomposition"]["4"]["batch_ms"] == 2.1 \
+            and sv["decomposition"]["1"]["device_ms"] == 0.8, \
+            "latency decomposition lost"
         assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
         deltas = phase_deltas([e for e in last_run(load_events(path))
                                if e.get("event") == "episode"])

@@ -12,7 +12,10 @@ subprocess so the startup numbers mean what they claim:
   bench fails; the concurrent closed-loop load fills the large bucket.
 
 Output artifact (``--out``, default SERVE_r01.json): requests/s and
-p50/p99 per leg and per batch bucket, the two startup walls, and the
+p50/p99 per leg and per batch bucket, the two startup walls, each leg's
+SLO summary (deadline-miss ratio, pad waste, queue-wait fraction,
+error-budget burn rate and attainment against ``--slo-p99-ms`` — the
+``slo_*`` axes ``tools/bench_diff.py`` gates), and the
 scenario/platform provenance.  Usage:
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py --out SERVE_r01.json
@@ -65,12 +68,15 @@ def _train_tiny(tmp: str):
 
 
 def _serve_leg(configs, ckpt, extra, *, requests, concurrency, buckets,
-               deadline_ms, cache_dir, result_dir, timeout_s=900):
+               deadline_ms, cache_dir, result_dir, slo_p99_ms=None,
+               timeout_s=900):
     cmd = [sys.executable, "-m", "gsc_tpu.cli", "serve", *configs, ckpt,
            *extra, "--requests", str(requests),
            "--concurrency", str(concurrency), "--buckets", buckets,
            "--deadline-ms", str(deadline_ms),
            "--artifact-cache", cache_dir, "--result-dir", result_dir]
+    if slo_p99_ms is not None:
+        cmd += ["--slo-p99-ms", str(slo_p99_ms)]
     t0 = time.perf_counter()
     proc = subprocess.run(cmd, cwd=REPO, env=_env(), capture_output=True,
                           text=True, timeout=timeout_s)
@@ -93,6 +99,14 @@ def main(argv=None) -> int:
                     help="requests per leg [default 200]")
     ap.add_argument("--buckets", default="1,8")
     ap.add_argument("--deadline-ms", type=float, default=5.0)
+    ap.add_argument("--slo-p99-ms", type=float, default=250.0,
+                    help="latency objective handed to each leg's SLO "
+                         "engine — generous by default so attainment/"
+                         "burn reflect real trouble, not CPU jitter; "
+                         "the banked per-leg `slo` block (deadline-miss "
+                         "ratio, pad waste, queue-wait fraction, burn "
+                         "rate, attainment) is what bench_diff gates "
+                         "under the slo_* bands [default 250]")
     ap.add_argument("--configs", default=None,
                     help="agent,sim,service,scheduler yaml paths (comma-"
                          "separated) for a non-tiny scenario")
@@ -125,13 +139,15 @@ def main(argv=None) -> int:
     legs["cold"] = _serve_leg(
         configs, ckpt, extra, requests=args.requests, concurrency=1,
         buckets=args.buckets, deadline_ms=args.deadline_ms,
-        cache_dir=cache_dir, result_dir=os.path.join(tmp, "serve_cold"))
+        cache_dir=cache_dir, result_dir=os.path.join(tmp, "serve_cold"),
+        slo_p99_ms=args.slo_p99_ms)
     # warm: same cache, fresh process, concurrent clients -> large bucket
     legs["warm"] = _serve_leg(
         configs, ckpt, extra, requests=args.requests,
         concurrency=max(bucket_list), buckets=args.buckets,
         deadline_ms=args.deadline_ms, cache_dir=cache_dir,
-        result_dir=os.path.join(tmp, "serve_warm"))
+        result_dir=os.path.join(tmp, "serve_warm"),
+        slo_p99_ms=args.slo_p99_ms)
 
     hits = {b: rec["cache_hit"]
             for b, rec in legs["warm"]["startup"]["buckets"].items()}
@@ -165,6 +181,7 @@ def main(argv=None) -> int:
         "buckets": bucket_list,
         "deadline_ms": args.deadline_ms,
         "requests_per_leg": args.requests,
+        "slo_p99_ms": args.slo_p99_ms,
         "cold_start_s": legs["cold"]["startup"]["startup_s"],
         "cache_hit_start_s": legs["warm"]["startup"]["startup_s"],
         "legs": {
@@ -173,6 +190,10 @@ def main(argv=None) -> int:
                    "rps": leg["rps"], "p50_ms": leg["p50_ms"],
                    "p99_ms": leg["p99_ms"],
                    "process_wall_s": leg["process_wall_s"],
+                   # the leg's SLO verdict (deadline-miss ratio, pad
+                   # waste, queue-wait fraction, burn rate, attainment)
+                   # — bench_diff gates these under the slo_* bands
+                   "slo": leg.get("slo"),
                    "startup": leg["startup"],
                    "buckets": leg["buckets"]}
             for name, leg in legs.items()},

@@ -1,0 +1,206 @@
+"""Serve-obs smoke: request-path tracing + SLO engine end to end.
+
+The CI-stage proof that the serving observability layer executes through
+the real CLI: a tiny SPR-tier serve run (no checkpoint — the fallback
+tier shares the whole batcher/tracer/SLO path without paying an AOT
+compile) with request-span sampling on and a deliberately LOW
+``--slo-p99-ms`` must
+
+- exit 0 with a complete ``slo`` block in its JSON output and a
+  schema-versioned ``slo.json`` in the result dir (objectives echoed,
+  attainment + burn rate + deadline-miss ratio + pad waste + latency
+  decomposition all present),
+- leave ``serve_flush`` spans (always recorded) and head-sampled
+  ``serve_request_span`` events in ``events.jsonl`` whose
+  queue + batch + device decomposition sums to the recorded latency,
+- export through ``gsc_tpu.obs.trace.build_trace`` as VALID trace-event
+  JSON with slices on the serve/serve_request tracks and at least one
+  request→flush flow arrow (``validate_trace`` returns no errors),
+- scrape cleanly over the live ``/metrics`` endpoint, with the
+  hub's LIVE queue-depth probe current at snapshot time (in-process
+  roundtrip — a fixed port would collide across concurrent CI stages),
+- gate through ``bench_diff``: the run's slo.json row self-compares
+  clean (rc 0) while an injected deadline-miss regression is caught
+  (rc 1).
+
+Run by ``tools/ci_check.sh`` after the learnobs stage; standalone:
+
+    JAX_PLATFORMS=cpu python tools/serveobs_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REQUESTS = 24
+SLO_P99_MS = "1"        # deliberately low: misses must be observable
+
+
+def fail(msg: str) -> int:
+    print(f"serveobs smoke: FAIL — {msg}")
+    return 1
+
+
+def check_endpoint() -> str:
+    """In-process /metrics roundtrip with a LIVE gauge registered: the
+    scrape must carry the probe's CURRENT value, and every series must
+    parse back identical to the snapshot."""
+    from gsc_tpu.obs import MetricsEndpoint, MetricsHub
+
+    hub = MetricsHub(tags={"run": "smoke"})
+    hub.counter("serve_rejected_total", 2, reason="queue_full")
+    depth = {"value": 3}
+    hub.live_gauge("serve_queue_depth", lambda: depth["value"])
+    ep = MetricsEndpoint(hub, port=0).start()
+    try:
+        depth["value"] = 7    # mutate AFTER registration: scrape must see 7
+        body = urllib.request.urlopen(ep.url, timeout=10).read().decode()
+        parsed = {}
+        for line in body.strip().splitlines():
+            name, value = line.rsplit(" ", 1)
+            parsed[name] = float(value)
+        depth_key = 'gsc_serve_queue_depth{run="smoke"}'
+        if parsed.get(depth_key) != 7.0:
+            return (f"live queue-depth probe stale in scrape: "
+                    f"{parsed.get(depth_key)}")
+        snap = {k: float(v) for k, v in hub.snapshot().items()}
+        if parsed != snap:
+            return f"endpoint scrape != snapshot ({parsed} vs {snap})"
+    finally:
+        ep.stop()
+    return ""
+
+
+def main() -> int:
+    from chaos_smoke import _configure_jax, write_tiny_configs
+    _configure_jax()
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+
+    err = check_endpoint()
+    if err:
+        return fail(err)
+
+    tmp = tempfile.mkdtemp(prefix="gsc_serveobs_")
+    args = write_tiny_configs(os.path.join(tmp, "cfg"))
+    configs = args[:4]
+    extra = [a for a in args[4:] if a != "--quiet"]
+    r = CliRunner().invoke(cli, [
+        "serve", *configs, *extra,          # no checkpoint: SPR tier
+        "--requests", str(REQUESTS), "--concurrency", "4",
+        "--buckets", "1,4", "--deadline-ms", "2", "--pool-steps", "2",
+        "--trace-sample", "1", "--slo-p99-ms", SLO_P99_MS,
+        "--result-dir", os.path.join(tmp, "res")])
+    if r.exit_code != 0:
+        print(r.output)
+        if r.exception is not None:
+            import traceback
+            traceback.print_exception(type(r.exception), r.exception,
+                                      r.exception.__traceback__)
+        return fail(f"serve rc={r.exit_code}")
+    out = json.loads(r.output.strip().splitlines()[-1])
+    if out["errors"]:
+        return fail(f"serve answered with errors: {out['error_detail']}")
+    rdir = out["result_dir"]
+    slo_out = out.get("slo") or {}
+    if slo_out.get("deadline_miss_ratio") is None \
+            or slo_out.get("attainment") is None \
+            or slo_out.get("burn_rate") is None:
+        return fail(f"CLI slo block incomplete: {slo_out}")
+
+    # slo.json: complete, schema-versioned, objectives echoed
+    slo_path = os.path.join(rdir, "slo.json")
+    if not os.path.exists(slo_path):
+        return fail("slo.json not written")
+    doc = json.load(open(slo_path))
+    if doc.get("schema_version") != 1:
+        return fail(f"slo.json schema wrong: {doc.get('schema_version')}")
+    if (doc.get("objectives") or {}).get("p99_ms") != float(SLO_P99_MS):
+        return fail(f"slo.json objectives not echoed: "
+                    f"{doc.get('objectives')}")
+    for key in ("deadline_miss_ratio", "attainment", "burn_rate",
+                "pad_waste", "arrival_rate_rps", "queue_wait_frac"):
+        if doc.get(key) is None:
+            return fail(f"slo.json missing {key}")
+    if doc.get("requests") != REQUESTS:
+        return fail(f"slo.json requests {doc.get('requests')} != "
+                    f"{REQUESTS}")
+    if not doc.get("decomposition_ms"):
+        return fail("slo.json missing the latency decomposition")
+
+    # span events: flush-level always, request spans sampled at N=1
+    events = [json.loads(line)
+              for line in open(os.path.join(rdir, "events.jsonl"))]
+    flushes = [e for e in events if e["event"] == "serve_flush"]
+    spans = [e for e in events if e["event"] == "serve_request_span"]
+    if not flushes:
+        return fail("no serve_flush events recorded")
+    if len(spans) != REQUESTS:
+        return fail(f"--trace-sample 1 should span every request: "
+                    f"{len(spans)} != {REQUESTS}")
+    for s in spans[:5]:
+        total = s["queue_wait_ms"] + s["batch_wait_ms"] + s["device_ms"]
+        if abs(total - s["latency_ms"]) > 0.01:
+            return fail(f"span decomposition does not sum to latency: {s}")
+
+    # trace export: valid, with serve_request slices + flow arrows
+    from gsc_tpu.obs.trace import (TRACE_TRACKS, build_trace, read_events,
+                                   validate_trace)
+    trace = build_trace(read_events(rdir))
+    errors = validate_trace(trace)
+    if errors:
+        return fail(f"trace invalid: {errors[:3]}")
+    req_tid = TRACE_TRACKS["serve_request"]
+    req_slices = [e for e in trace["traceEvents"]
+                  if e.get("ph") == "X" and e.get("tid") == req_tid]
+    flows = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    if len(req_slices) != REQUESTS:
+        return fail(f"serve_request track has {len(req_slices)} slices, "
+                    f"want {REQUESTS}")
+    if not flows:
+        return fail("no request->flush flow arrows in the trace")
+
+    # bench_diff gate: self-compare clean, injected regression caught
+    import bench_diff
+    traj = os.path.join(tmp, "traj.json")
+    doc2 = bench_diff.ingest([slo_path], traj)
+    (row_name,) = [n for n in doc2["rows"] if n.startswith("slo_")]
+    rc = bench_diff.main(["diff", row_name, "--baseline", row_name,
+                          "--trajectory", traj])
+    if rc != 0:
+        return fail(f"slo self-compare rc={rc} (want 0)")
+    # inject on pad_waste, which can never saturate at 1.0 on a real run
+    # (a flush always carries >= 1 real request) — a deadline-miss ratio
+    # already at 1.0 under the deliberately-low objective would leave no
+    # headroom to regress into
+    bad = dict(doc)
+    bad["pad_waste"] = (doc["pad_waste"] or 0.0) + 0.5
+    bad["deadline_miss_ratio"] = min(
+        (doc["deadline_miss_ratio"] or 0.0) + 0.5, 1.0)
+    bad_path = os.path.join(tmp, "bad_slo.json")
+    with open(bad_path, "w") as f:
+        json.dump(bad, f)
+    rc = bench_diff.main(["diff", bad_path, "--baseline", row_name,
+                          "--trajectory", traj])
+    if rc != 1:
+        return fail(f"injected SLO regression rc={rc} (want 1)")
+
+    print(f"serveobs smoke: OK — {len(spans)} request spans across "
+          f"{len(flushes)} flushes, slo.json complete + gated "
+          f"(deadline-miss {doc['deadline_miss_ratio']}, pad-waste "
+          f"{doc['pad_waste']}), trace valid with flow links, "
+          "/metrics live-gauge scrape clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
