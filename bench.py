@@ -169,20 +169,15 @@ def _mesh():
     raw = os.environ.get("GSC_BENCH_MESH", "").strip()
     if not raw:
         return None
-    # mirrors parallel.partition.parse_mesh_shape (positive axes only) —
-    # NOT imported here: the orchestrator must stay jax-free so the
-    # parent process never claims the TPU alongside its workers
-    import re
-    if not re.fullmatch(r"[1-9]\d*(?:x[1-9]\d*)?", raw.lower()):
-        raise SystemExit(
-            f"GSC_BENCH_MESH={raw!r} is not 'DPxMP' with positive axes "
-            "(e.g. 8x1, 4x2)")
-    mesh = raw.lower()
-    # canonical DPxMP form: a bare 'N' means mp=1 — every other surface
-    # (cli run_start meta, obs_report, dryrun rows) records 'Nx1', and a
-    # mesh field that splits one shape into two spellings breaks
-    # cross-artifact grouping
-    return mesh if "x" in mesh else f"{mesh}x1"
+    # the ONE grammar definition (gsc_tpu.meshspec) — jax-free on
+    # purpose, so the orchestrator still never claims the TPU alongside
+    # its workers; canonical 'dpxmp' spelling (bare 'N' -> 'Nx1') keeps
+    # cross-artifact grouping from splitting one shape into two strings
+    from gsc_tpu.meshspec import canonical_mesh
+    try:
+        return canonical_mesh(raw)
+    except ValueError as e:
+        raise SystemExit(f"GSC_BENCH_MESH={raw!r}: {e}")
 
 
 def _topo_mix():
@@ -200,15 +195,20 @@ def _topo_mix():
 def _partition_rules() -> str:
     """Partition rulebook under ``--mesh`` (``--partition-rules`` /
     GSC_BENCH_PARTITION_RULES): 'replicated' (default — params on every
-    device, the bit-identical fallback) or 'sharded' (wide matrices +
-    Adam moments split over mp).  Recorded on rows only when a mesh is
+    device, the bit-identical fallback), 'sharded' (wide matrices +
+    Adam moments split over mp, bit-exact by construction) or 'tp'
+    (true tensor-parallel compute — resident-sharded state, psum
+    partial products; rows gate under the bench_diff tolerance bands
+    vs a replicated control, never by digest).  Vocabulary lives in
+    gsc_tpu.meshspec (jax-free).  Recorded on rows only when a mesh is
     set — without one the knob has nothing to partition."""
+    from gsc_tpu.meshspec import validate_partition_rules
     rules = (os.environ.get("GSC_BENCH_PARTITION_RULES", "replicated")
              .strip() or "replicated")
-    if rules not in ("replicated", "sharded"):
-        raise SystemExit(f"GSC_BENCH_PARTITION_RULES={rules!r} "
-                         "(expected replicated|sharded)")
-    return rules
+    try:
+        return validate_partition_rules(rules)
+    except ValueError as e:
+        raise SystemExit(f"GSC_BENCH_PARTITION_RULES: {e}")
 
 
 def ladder():
@@ -859,28 +859,29 @@ if __name__ == "__main__":
     if "--mesh" in argv:
         # forwarded to worker subprocesses via the environment like
         # --precision; a missing/garbled value must ERROR — a silently
-        # meshless row would mislabel a run meant to measure multi-chip
-        import re as _re
+        # meshless row would mislabel a run meant to measure multi-chip.
+        # Grammar + canonical 'Nx1' spelling come from gsc_tpu.meshspec
+        # (jax-free), the same definition _mesh() reads back
+        from gsc_tpu.meshspec import canonical_mesh
         i = argv.index("--mesh")
         mesh = argv[i + 1] if i + 1 < len(argv) else None
-        # positive-axes grammar, kept in sync with _mesh() (see the
-        # jax-free-parent note there)
-        if mesh is None or not _re.fullmatch(r"[1-9]\d*(?:x[1-9]\d*)?",
-                                             mesh.lower()):
+        try:
+            os.environ["GSC_BENCH_MESH"] = canonical_mesh(mesh)
+        except ValueError:
             raise SystemExit(f"--mesh expects 'DPxMP' with positive axes "
                              f"(e.g. 8x1, 4x2), got {mesh!r}")
-        mesh = mesh.lower()
-        # canonicalize bare 'N' -> 'Nx1' (matches _mesh(); one spelling
-        # per shape across every surface)
-        os.environ["GSC_BENCH_MESH"] = (mesh if "x" in mesh
-                                        else f"{mesh}x1")
         del argv[i:i + 2]
     if "--partition-rules" in argv:
+        from gsc_tpu.meshspec import (PARTITION_RULEBOOKS,
+                                      validate_partition_rules)
         i = argv.index("--partition-rules")
         rules = argv[i + 1] if i + 1 < len(argv) else None
-        if rules not in ("replicated", "sharded"):
+        try:
+            validate_partition_rules(rules)
+        except ValueError:
             raise SystemExit(f"--partition-rules expects "
-                             f"replicated|sharded, got {rules!r}")
+                             f"{'|'.join(PARTITION_RULEBOOKS)}, "
+                             f"got {rules!r}")
         os.environ["GSC_BENCH_PARTITION_RULES"] = rules
         del argv[i:i + 2]
     if "--perf" in argv:

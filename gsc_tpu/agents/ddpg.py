@@ -394,11 +394,18 @@ class DDPG:
         plug in here.
 
         ``constrain`` (optional; the sharded multi-chip path) re-pins the
-        carried learner state at the top of every gradient step — without
-        it, GSPMD's fixpoint solve pulls the caller's sharded state
-        layout INTO the loop carry and steps 2..N compute tensor-parallel
-        with carving-dependent reduction order.  ``None`` (the default,
-        every single-agent path) traces the historic body verbatim."""
+        carried learner state — top of every gradient step AND the
+        back-edge — to the layout the caller's plan intends.  The
+        replicated/sharded books pin to REPLICATED: without it, GSPMD's
+        fixpoint solve pulls the caller's sharded state layout INTO the
+        loop carry and steps 2..N compute tensor-parallel with
+        carving-dependent reduction order, breaking their bit-equality
+        contract.  The ``tp`` book pins to its OWN sharded layout: there
+        tensor-parallel compute is the point, and the constraint keeps
+        the fixpoint ON that layout so every step's contractions psum
+        the same way (acceptance is banded, see
+        ``parallel.partition.tp_rules``).  ``None`` (the default, every
+        single-agent path) traces the historic body verbatim."""
         rng, sub = jax.random.split(state.rng)
         state = state.replace(rng=sub)
 
@@ -418,11 +425,11 @@ class DDPG:
             if constrain is not None:
                 # pin the RETURNED carry too: the constraint on entry
                 # alone leaves the loop's back-edge free for GSPMD to
-                # settle on the caller's sharded layout, which then
-                # back-propagates through the Adam/Polyak updates into
-                # the gradient dots — the update math must stay
-                # replicated end to end, with the single reshard at the
-                # program boundary (out_shardings)
+                # settle on whatever layout minimizes the first step,
+                # which then back-propagates through the Adam/Polyak
+                # updates into the gradient dots — the update math must
+                # stay on the INTENDED layout end to end (replicated for
+                # the bit-exact books, the plan's sharded layout for tp)
                 st = constrain(st)
             return st, metrics
 

@@ -351,6 +351,14 @@ class Trainer:
         disp = phases.get("dispatch")
         if primary is not None and disp:
             perf.note_timing(primary, disp["total_s"], disp["count"])
+            if perf.has(f"{primary}_sharded"):
+                # under a plan the dispatched program IS the partitioned
+                # executable — the same dispatch wall attributes to its
+                # capture too, so its MFU/roofline derive from the HLO
+                # that actually ran (the plain entry keeps the
+                # carving-comparable number)
+                perf.note_timing(f"{primary}_sharded", disp["total_s"],
+                                 disp["count"])
         perf.note_phases(phases)
 
     def _prefetch_fault_hook(self):
@@ -824,7 +832,12 @@ class Trainer:
         caller's final checkpoint + evaluation never see mesh residency.
         Elastic resume = restore those host arrays under a DIFFERENT
         plan: the first dispatch reshards them onto whatever mesh the
-        resuming process built.
+        resuming process built.  Under the ``tp`` book the state is
+        RESIDENT-sharded through the compiled program (no entry/exit
+        layout moves at all) — this loop still never touches mesh
+        residency between dispatches: the ONLY host gathers are the
+        save boundaries and the final return below, where
+        ``gather_state`` assembles the sharded leaves directly.
 
         Resilience on this path: preemption stop + periodic checkpoints
         (finite-verified host-side — there is no rollback guard here);
@@ -978,9 +991,13 @@ class Trainer:
                     # cost-ledger capture for the replica path: shapes-only
                     # reset via eval_shape (no device work), then AOT-lower
                     # the fused chunk kernel's steady-state variant.  Under
-                    # a sharding plan this lowers the PLAIN jit — the
-                    # per-call cost of the unsharded program, which is the
-                    # comparable number across mesh carvings — and because
+                    # a sharding plan this lowers the PLAIN jit — no
+                    # explicit in_/out_shardings, the carving-comparable
+                    # number (the traced body still carries the plan's
+                    # with_sharding_constraints, so under `tp` even this
+                    # program partitions — the _sharded capture below is
+                    # the one that mines the dispatched layout) — and
+                    # because
                     # the sharded dispatch jits its own copy, that capture
                     # trace would read as a spurious chunk_step retrace in
                     # the sentinel stream: pause the monitor for exactly
@@ -1007,6 +1024,33 @@ class Trainer:
                             "learn_burst": (
                                 l_fn, (*l_pre, state, buffers), {}),
                         })
+                        if plan is not None:
+                            # ALSO capture the PARTITIONED executable the
+                            # sharded dispatch actually runs: its HLO
+                            # carries the collective ops (all-reduce
+                            # count/bytes) the plain capture above cannot
+                            # show — the machine-read half of the
+                            # tp-vs-sharded interconnect claim.  One
+                            # extra AOT compile at startup (--no-perf
+                            # skips it); under the multi-device CPU
+                            # cache wart the lowering must run with the
+                            # persistent cache disabled, same guard as
+                            # the dispatch compiles.  The sharded jit
+                            # takes statics positionally (in_shardings
+                            # rejects kwargs).
+                            from ..parallel.partition import \
+                                no_persistent_compile_cache
+                            s_fn = pddpg.sharded_lowerable("chunk_step",
+                                                           state)
+                            with no_persistent_compile_cache(plan.mesh):
+                                self._capture_costs({
+                                    "chunk_step_sharded": (
+                                        s_fn,
+                                        (state, buffers, es_s, obs_s,
+                                         topo, traffic,
+                                         np.int32(ep * steps_per_ep),
+                                         chunk, True), {}),
+                                })
                     except Exception as e:  # noqa: BLE001 - never fatal
                         log.warning("cost-ledger capture skipped on the "
                                     "replica path: %s", e)

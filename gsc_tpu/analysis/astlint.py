@@ -66,8 +66,11 @@ _WRAPPER_PREFIXES = {"jax", "lax", "nn", "pl", "pallas", "functools",
 # (ParallelDDPG._bind_sharded_dispatch) rebinds chunk_step /
 # rollout_episodes / learn_burst with explicit in_/out_shardings but the
 # SAME names, argument orders and donate_argnums as the donated_jit
-# path, so the entries below cover both — a new sharded entry point with
-# a different signature must get its own row here.
+# path, so the entries below cover both — and the PR 13 `tp` book only
+# changes WHICH shardings those rebinds carry (resident-sharded state
+# in place of replicated), never a name, order or donation, so no new
+# row is needed for it either.  A new sharded entry point with a
+# different signature must get its own row here.
 DONATED_SIGS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...],
                               Tuple[int, ...]]] = {
     "episode_step": ((0, 1, 2), ("state", "buffer", "env_state"), (7, 8)),

@@ -21,7 +21,10 @@ argument is an already-compiled jax ``Compiled`` object (or its
 """
 from __future__ import annotations
 
-__all__ = ["count_fusions", "count_ops", "hlo_text", "op_histogram"]
+import re
+
+__all__ = ["collective_stats", "count_fusions", "count_ops", "hlo_text",
+           "op_histogram"]
 
 
 def hlo_text(compiled_or_text) -> str:
@@ -58,3 +61,86 @@ def op_histogram(compiled_or_text, ops) -> dict:
     the batch form the cost ledger (obs.perf) stores per entry point."""
     text = hlo_text(compiled_or_text)
     return {op: text.count(f" {op}(") for op in ops}
+
+
+#: the cross-device movers a partitioned program can contain — the
+#: interconnect cost the `tp` rulebook spends bit-equality to reduce.
+#: Async forms (``all-reduce-start``/``-done``) count as ONE op on the
+#: ``-start`` side (the ``-done`` is the same transfer completing).
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# every `dtype[dims]` occurrence in an HLO result type, tuple results
+# included: `(f32[4,8]{1,0}, f32[4]{0})`
+_SHAPE_RE = re.compile(r"([a-z]\d*[a-z0-9]*|pred)\[([0-9,]*)\]")
+
+
+def _shape_bytes(type_text: str, largest_only: bool = False) -> int:
+    """Payload bytes of an HLO result-type string: the sum over tuple
+    elements, or with ``largest_only`` just the biggest one — async
+    ``-start`` forms return ``(operand, result)`` tuples, where summing
+    would double-count the transfer (the result is the payload; for
+    all-gather it is the larger element, for all-reduce both are
+    equal)."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * size)
+    if not sizes:
+        return 0
+    return max(sizes) if largest_only else sum(sizes)
+
+
+def collective_stats(compiled_or_text, ops=COLLECTIVE_OPS) -> dict:
+    """Per-collective count + payload bytes of a compiled executable:
+    ``{"ops": {op: {"count", "bytes"}}, "count": total, "bytes": total}``.
+
+    Bytes are summed over each collective instruction's RESULT shape
+    (the text between ``=`` and the op name — operand shapes inside the
+    parens never match), so an ``all-gather`` counts its gathered output
+    and an ``all-reduce`` its reduced tensor.  This is a per-call
+    *payload* figure, not wire traffic (a ring all-reduce moves
+    ~2x(n-1)/n of it per hop) — stable across backends, which is what a
+    tp-vs-sharded interconnect comparison needs.  Ops inside while-loop
+    bodies count once per program, same convention as
+    :func:`count_fusions`."""
+    text = hlo_text(compiled_or_text)
+    per_op = {op: {"count": 0, "bytes": 0} for op in ops}
+    for line in text.splitlines():
+        # `head` holds the instruction name only; the result type leads
+        # the right-hand side, before the op token
+        head, eq, rhs = line.partition("=")
+        if not eq:
+            continue
+        for op in ops:
+            idx, is_start = -1, False
+            for token, start in ((f" {op}(", False),
+                                 (f" {op}-start(", True)):
+                idx = rhs.find(token)
+                if idx >= 0:
+                    is_start = start
+                    break
+            if idx < 0:
+                continue
+            rec = per_op[op]
+            rec["count"] += 1
+            rec["bytes"] += _shape_bytes(rhs[:idx],
+                                         largest_only=is_start)
+            break
+    present = {op: rec for op, rec in per_op.items() if rec["count"]}
+    return {"ops": present,
+            "count": sum(r["count"] for r in present.values()),
+            "bytes": sum(r["bytes"] for r in present.values())}

@@ -12,7 +12,12 @@ makes that evidence a per-run artifact: every watched jitted entry point
   accessed per call;
 - HLO structure (:mod:`gsc_tpu.analysis.hlo`): fusion count — the
   op-count perf proxy the megakernel campaign gates on — plus a small
-  op histogram (while/dot/scatter/gather);
+  op histogram (while/dot/scatter/gather) and the collective-op stats
+  (all-reduce/all-gather/reduce-scatter count + payload bytes) that
+  make the ``tp``-vs-``sharded`` interconnect comparison machine-read
+  (on a sharded dispatch the trainer additionally captures the
+  PARTITIONED executable as ``<entry>_sharded`` — the plain entry stays
+  the carving-comparable number);
 - executable memory residency (``compiled.memory_analysis()``).
 
 Wall timings arrive separately via :meth:`CostLedger.note_timing` — fed
@@ -41,7 +46,7 @@ import logging
 import time
 from typing import Dict, Optional
 
-from ..analysis.hlo import count_fusions, op_histogram
+from ..analysis.hlo import collective_stats, count_fusions, op_histogram
 
 log = logging.getLogger("gsc_tpu.obs.perf")
 
@@ -186,6 +191,12 @@ class CostLedger:
             "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
             "fusions": count_fusions(hlo) if hlo else None,
             "ops": op_histogram(hlo, _OP_HISTOGRAM) if hlo else {},
+            # cross-device movers (all-reduce/all-gather/reduce-scatter
+            # ... count + payload bytes per call): 0/{} on single-device
+            # programs; on a partitioned executable this is the
+            # machine-read side of the tp-vs-sharded interconnect claim
+            "collectives": (collective_stats(hlo) if hlo
+                            else {"ops": {}, "count": 0, "bytes": 0}),
         }
         if entry["flops"] and entry["bytes_accessed"]:
             entry["arithmetic_intensity"] = round(
@@ -205,7 +216,8 @@ class CostLedger:
                            flops=entry["flops"],
                            bytes_accessed=entry["bytes_accessed"],
                            fusions=entry["fusions"],
-                           ops=entry["ops"])
+                           ops=entry["ops"],
+                           collectives=entry["collectives"])
             if entry["fusions"] is not None:
                 self.hub.gauge("compile_fusions", entry["fusions"], fn=name)
         return entry

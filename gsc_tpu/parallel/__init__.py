@@ -4,11 +4,12 @@ from .mesh import (force_virtual_cpu, make_mesh, put_replicated,
                    put_sharded, replicated, sharded_axis0)
 from .partition import (ShardingPlan, make_shard_and_gather_fns,
                         make_train_mesh, match_partition_rules,
-                        parse_mesh_shape, sharded_rules, spec_summary)
+                        parse_mesh_shape, sharded_rules, spec_summary,
+                        tp_rules)
 
 __all__ = ["ParallelDDPG", "force_virtual_cpu", "make_mesh",
            "put_replicated", "put_sharded",
            "replicated", "sharded_axis0",
            "ShardingPlan", "make_shard_and_gather_fns", "make_train_mesh",
            "match_partition_rules", "parse_mesh_shape", "sharded_rules",
-           "spec_summary"]
+           "spec_summary", "tp_rules"]

@@ -114,38 +114,56 @@ class ParallelDDPG:
         keyword signature and forward positionally."""
         from functools import partial as _partial
 
+        from jax.sharding import NamedSharding
+
         cls = type(self)
         plan = self.plan
         data, rep = plan.data_sharding, plan.replicated
         topo_sh = data if self.per_replica_topology else rep
+        # the tp book keeps the learner state RESIDENT-sharded through
+        # the compiled program; the entry-placement counter below is the
+        # no-layout-move witness tests assert on (exactly one placement
+        # per caller-fresh state, zero on the steady-state dispatch path)
+        tp = plan.resident_sharded
+        self.entry_state_moves = 0
         fns = {}
 
         def build(state):
-            # ZeRO-style weight sharding: the learner state RESIDES
-            # sharded between dispatches (params + Adam moments split
-            # over mp per the plan's rules — the HBM-residency win), but
-            # the COMPILED PROGRAM only ever sees it replicated: the
-            # wrappers below allgather it with an eager ``device_put``
-            # on the way in and slice it back to shards on the way out
-            # (pure layout moves, never a retrace).  With no mp
-            # annotation inside the program, the partitioned executable
-            # is identical for every carving of the same device count —
-            # which is exactly what makes the final learner state
-            # BIT-identical across mesh shapes.  Keeping params sharded
-            # THROUGH the dots instead (true tensor-parallel compute)
-            # psums the backward dx = dy @ W^T over mp shards in a
-            # carving-dependent order (measured: one gradient step
-            # drifts ~1e-7 per mp size) — a deliberate non-goal until
-            # bit-equality can be traded away.
+            # Two residency designs share this binding:
+            #
+            # replicated/sharded books (PR 8, ZeRO-style): the learner
+            # state RESIDES sharded between dispatches (params + Adam
+            # moments split over mp per the plan's rules — the
+            # HBM-residency win), but the COMPILED PROGRAM only ever
+            # sees it replicated: the wrappers below allgather it with
+            # an eager ``device_put`` on the way in and slice it back to
+            # shards on the way out (pure layout moves, never a
+            # retrace).  With no mp annotation inside the program, the
+            # partitioned executable is identical for every carving of
+            # the same device count — which is exactly what makes the
+            # final learner state BIT-identical across mesh shapes.
+            #
+            # tp book (true tensor-parallel compute): the state's
+            # in_/out_shardings ARE the plan's partition layout, so it
+            # stays sharded THROUGH the program — the entry-allgather /
+            # exit-slice moves are deleted (the real HBM + interconnect
+            # win) and GSPMD psums the partial products of the sharded
+            # contractions.  The psum reduces shards in a
+            # carving-dependent order (~1e-7 drift per mp size per
+            # gradient step), so tp runs are accepted under the
+            # bench_diff tolerance bands, never by digest.
             ss = plan.state_shardings(state)
             fns["_state_shardings"] = ss
+            fns["_ss_leaves"] = jax.tree_util.tree_leaves(
+                ss, is_leaf=lambda x: isinstance(x, NamedSharding))
+            state_sh = ss if tp else rep
             # dynamic args of all three entry points, in order: state,
             # buffers, env_states, obs, topo, traffic, start (static
             # self/num_steps/learn are excluded from in_shardings).  A
             # per-replica topology carries the [B] replica axis, so it
             # shards like the other batch data; the historic single-
             # topology path keeps it replicated.
-            arg_sh = (rep, data, data, data, topo_sh, data, rep)
+            arg_sh = (state_sh, data, data, data, topo_sh, data, rep)
 
             def shard_jit(method, static, donate_pos, n_in, out_sh):
                 fn = getattr(method, "__wrapped__", method)
@@ -157,20 +175,41 @@ class ParallelDDPG:
 
             fns["chunk_step"] = shard_jit(
                 cls.chunk_step, (0, 8, 9), (1, 2), 7,
-                (rep, data, data, data, rep, rep))
+                (state_sh, data, data, data, rep, rep))
             fns["rollout_episodes"] = shard_jit(
                 cls.rollout_episodes, (0, 8), (2,), 7,
-                (rep, data, data, data, rep))
+                (state_sh, data, data, data, rep))
             fns["learn_burst"] = shard_jit(
-                cls.learn_burst, (0,), (1,), 2, (rep, rep))
+                cls.learn_burst, (0,), (1,), 2, (state_sh, rep))
             return fns
 
-        def gather_in(state):
-            # entry allgather: ss -> replicated (no-op for a state that
-            # is already replicated, e.g. the first dispatch)
-            return jax.device_put(state, rep)
+        def state_in(state):
+            if not tp:
+                # entry allgather: ss -> replicated (no-op for a state
+                # that is already replicated, e.g. the first dispatch)
+                return jax.device_put(state, rep)
+            # tp: the state is resident in the program's own layout —
+            # a caller-fresh tree (init, restore) is placed exactly
+            # once; every carry rebound from our outputs already
+            # matches and passes through UNTOUCHED (no device_put, no
+            # allgather — the contract tests assert via the counter).
+            # All-leaf check, not first-leaf: a host-rebuilt leaf (e.g.
+            # state.replace(rng=...)) must re-place, or the jit would
+            # reject the mismatched committed leaf.
+            ss_leaves = fns["_ss_leaves"]
+            leaves = jax.tree_util.tree_leaves(state)
+            if len(leaves) == len(ss_leaves) and all(
+                    getattr(l, "sharding", None) == s
+                    for l, s in zip(leaves, ss_leaves)):
+                return state
+            self.entry_state_moves += 1
+            return jax.device_put(state, fns["_state_shardings"])
 
-        def shard_out(state):
+        def state_out(state):
+            if tp:
+                # already in the plan's residency via out_shardings —
+                # returning it unmoved IS the deleted exit slice
+                return state
             # exit slice: replicated -> the plan's sharded residency
             return jax.device_put(state, fns["_state_shardings"])
 
@@ -223,34 +262,55 @@ class ParallelDDPG:
                        episode_start_step, num_steps=None, learn=False):
             fn = fns.get("chunk_step") or build(state)["chunk_step"]
             with no_persistent_compile_cache(plan.mesh):
-                out = fn(gather_in(state), put_data(buffers),
+                out = fn(state_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
                          put_once(topo, topo_sh), put_once(traffic, data),
                          jax.device_put(episode_start_step, rep),
                          num_steps, learn)
-            return (shard_out(out[0]),) + out[1:]
+            return (state_out(out[0]),) + out[1:]
 
         def rollout_episodes(state, buffers, env_states, obs, topo,
                              traffic, episode_start_step, num_steps=None):
             fn = (fns.get("rollout_episodes")
                   or build(state)["rollout_episodes"])
             with no_persistent_compile_cache(plan.mesh):
-                out = fn(gather_in(state), put_data(buffers),
+                out = fn(state_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
                          put_once(topo, topo_sh), put_once(traffic, data),
                          jax.device_put(episode_start_step, rep),
                          num_steps)
-            return (shard_out(out[0]),) + out[1:]
+            return (state_out(out[0]),) + out[1:]
 
         def learn_burst(state, buffers):
             fn = fns.get("learn_burst") or build(state)["learn_burst"]
             with no_persistent_compile_cache(plan.mesh):
-                out = fn(gather_in(state), put_data(buffers))
-            return (shard_out(out[0]),) + out[1:]
+                out = fn(state_in(state), put_data(buffers))
+            return (state_out(out[0]),) + out[1:]
 
         self.chunk_step = chunk_step
         self.rollout_episodes = rollout_episodes
         self.learn_burst = learn_burst
+        # the plan-bound jits themselves, for AOT capture (obs.perf mines
+        # the SHARDED executable's HLO — collective counts/bytes — next
+        # to the carving-comparable plain capture)
+        self._sharded_fns = fns
+        self._sharded_build = build
+
+    def sharded_lowerable(self, name: str, state):
+        """The plan-bound jit actually dispatched for ``name`` (a
+        ``functools.partial`` over a jit with explicit shardings), built
+        from ``state`` if the lazy binding has not happened yet; ``None``
+        without a plan.  Callers lower it AOT (``obs.perf.CostLedger``)
+        to mine the PARTITIONED program's HLO — fusions and collective
+        ops — which the unsharded class jit cannot show.  Lowering a
+        multi-device CPU program must run under
+        ``partition.no_persistent_compile_cache`` (same wart as the
+        dispatch compiles)."""
+        if self.plan is None:
+            return None
+        if name not in self._sharded_fns:
+            self._sharded_build(state)
+        return self._sharded_fns[name]
 
     # ----------------------------------------------------------------- init
     def init(self, rng, sample_obs) -> DDPGState:
@@ -424,12 +484,24 @@ class ParallelDDPG:
     # ------------------------------------------------------------- learning
     def _state_constraint(self):
         """Per-gradient-step learner-state re-pin for ``_learn_burst``:
-        under a plan the loop carry is constraint-gathered to replicated
-        at the top of every step (see the sharded-dispatch ZeRO note),
-        keeping every gradient step's math canonical; None without a
-        plan — the historic trace, byte for byte."""
+        under a replicated/sharded plan the loop carry is
+        constraint-gathered to replicated at the top of every step (see
+        the sharded-dispatch ZeRO note), keeping every gradient step's
+        math canonical.  Under the ``tp`` plan the pin is the PLAN'S OWN
+        sharded layout instead — the constraint keeps GSPMD's fixpoint
+        ON the tensor-parallel layout through steps 2..N and the
+        back-edge, so every gradient step contracts sharded dims with
+        psum accumulation (replacing the carry re-pin-to-replicated, not
+        just dropping it: an unconstrained carry lets the fixpoint drift
+        toward whatever layout minimizes the first step, changing the
+        accepted numerics run to run).  None without a plan — the
+        historic trace, byte for byte."""
         if self.plan is None:
             return None
+        if self.plan.resident_sharded:
+            plan = self.plan
+            return lambda st: jax.lax.with_sharding_constraint(
+                st, plan.state_shardings(st))
         rep = self.plan.replicated
         return lambda st: jax.lax.with_sharding_constraint(st, rep)
 
@@ -442,8 +514,12 @@ class ParallelDDPG:
         a batch left sharded would psum per-shard partial sums in a
         carving-dependent (dp-then-mp) order.  The gather this buys is
         one micro-batch per gradient step, orders of magnitude smaller
-        than the replay shards that stay distributed.  Without a plan
-        this is a no-op passthrough (the pre-partition stack verbatim)."""
+        than the replay shards that stay distributed.  The ``tp`` book
+        keeps the SAME replicated-batch pin (the Megatron pattern:
+        activations replicated/feature-sharded, weights sharded) — under
+        tp it is the weight contractions, not the batch, that psum.
+        Without a plan this is a no-op passthrough (the pre-partition
+        stack verbatim)."""
         if self.plan is None:
             return lambda k: sampler(buffers, k)
         rep = self.plan.replicated

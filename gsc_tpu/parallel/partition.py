@@ -45,6 +45,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..meshspec import (PARTITION_RULEBOOKS, parse_mesh_shape,
+                        validate_partition_rules)
 from .mesh import force_virtual_cpu
 
 log = logging.getLogger("gsc_tpu.parallel.partition")
@@ -78,22 +80,60 @@ def sharded_rules(mp_axis: str = "mp") -> Tuple[Tuple[str, P], ...]:
     )
 
 
-# ------------------------------------------------------------- mesh shapes
-def parse_mesh_shape(spec: str) -> Tuple[int, int]:
-    """``"DPxMP"`` -> ``(dp, mp)``; a bare ``"N"`` means ``Nx1``.
+def tp_rules(mp_axis: str = "mp") -> Tuple[Tuple[str, P], ...]:
+    """The TRUE tensor-parallel rulebook: contraction dims split over
+    ``mp``, partial products psum-accumulated by GSPMD.
 
-    Raises ``ValueError`` with the offending text for anything else —
-    callers (cli/bench) surface it as a flag error, never a traceback
-    from deep inside mesh construction."""
-    text = str(spec).strip().lower()
-    m = re.fullmatch(r"(\d+)(?:x(\d+))?", text)
-    if not m:
-        raise ValueError(
-            f"mesh shape {spec!r} is not 'DPxMP' (e.g. 8x1, 4x2) or 'N'")
-    dp, mp = int(m.group(1)), int(m.group(2) or 1)
-    if dp < 1 or mp < 1:
-        raise ValueError(f"mesh shape {spec!r} axes must be positive")
-    return dp, mp
+    Where :func:`sharded_rules` only ever splits output-feature dims
+    (keeping the float sequence — and therefore bit-equality — intact),
+    this book spends the precision contract for genuinely parallel
+    compute, Megatron-style within each block:
+
+    - first projections (``Dense_0`` kernels, GATv2 ``w_l``/``w_r``) are
+      COLUMN-parallel: the hidden/feature OUTPUT dim splits over ``mp``,
+      so each device computes its slice of the hidden activation;
+    - deeper MLP kernels (``Dense_1``..) are ROW-parallel: the hidden
+      CONTRACTION dim splits over ``mp`` — each device dots its
+      activation slice against its weight rows and GSPMD psums the
+      partial products (one all-reduce per column/row pair, not one per
+      layer);
+    - ``Dense_0`` biases follow their sharded pre-activation.
+
+    The psum reduces shards in a carving-dependent order, so a ``tp``
+    run drifts ~1e-7 per mp size against the replicated program per
+    gradient step — the documented floor.  Acceptance is BANDED, not
+    bit-exact: learning curves and bench rows must land inside
+    ``tools/bench_diff.py``'s tolerance envelope vs a replicated control
+    (ROADMAP item 2's trade).  Polyak targets and both Adam moments
+    share the param paths, so one rule shards all of them alike —
+    moments never reshard per update.  Attention vectors (``att``:
+    contraction over the sharded feature dim — GSPMD psums the logit),
+    remaining biases, scalars and PRNG keys fall through to
+    replication."""
+    return (
+        (r"Dense_0/kernel$", P(None, mp_axis)),
+        (r"Dense_0/bias$", P(mp_axis)),
+        (r"Dense_\d+/kernel$", P(mp_axis, None)),
+        (r"(w_l|w_r)$", P(None, mp_axis)),
+        (r".*", P()),
+    )
+
+
+#: rulebook-name -> builder for the named books every surface accepts
+#: (the vocabulary itself lives jax-free in ``gsc_tpu.meshspec``)
+NAMED_RULEBOOKS = {
+    "replicated": lambda: REPLICATED_RULES,
+    "sharded": sharded_rules,
+    "tp": tp_rules,
+}
+assert tuple(NAMED_RULEBOOKS) == PARTITION_RULEBOOKS
+
+
+# ------------------------------------------------------------- mesh shapes
+# the "DPxMP" grammar lives jax-free in gsc_tpu.meshspec (bench.py's
+# orchestrator shares it without importing jax); parse_mesh_shape is
+# imported above and re-exported so every historic import site keeps
+# working.
 
 
 def make_train_mesh(dp: int, mp: int = 1,
@@ -301,15 +341,15 @@ class ShardingPlan:
 
     ``rules`` is either a rulebook (sequence of ``(regex, spec)``) or
     one of the named books ``"replicated"`` (default — the bit-identical
-    no-op fallback) / ``"sharded"`` (:func:`sharded_rules`)."""
+    no-op fallback) / ``"sharded"`` (:func:`sharded_rules`) / ``"tp"``
+    (:func:`tp_rules` — true tensor-parallel compute: the learner state
+    stays RESIDENT-sharded through the compiled program, accepted under
+    tolerance bands instead of bit-equality)."""
 
     def __init__(self, mesh: Mesh, rules="replicated"):
+        self.rules_name = rules if isinstance(rules, str) else "custom"
         if isinstance(rules, str):
-            if rules not in ("replicated", "sharded"):
-                raise ValueError(
-                    f"unknown rulebook {rules!r} (replicated|sharded)")
-            rules = (REPLICATED_RULES if rules == "replicated"
-                     else sharded_rules())
+            rules = NAMED_RULEBOOKS[validate_partition_rules(rules)]()
         self.mesh = mesh
         self.rules = tuple(rules)
         self.dp = int(mesh.shape.get("dp", 1))
@@ -387,3 +427,14 @@ class ShardingPlan:
         """True iff any rule can split a leaf (mp>1 with a non-P() rule)
         — the replicated book or an mp=1 mesh is the no-op fallback."""
         return self.mp > 1 and any(spec != P() for _, spec in self.rules)
+
+    @property
+    def resident_sharded(self) -> bool:
+        """True for the ``tp`` book: the learner state stays sharded
+        THROUGH the compiled program (in_/out_shardings are the plan's
+        partition layout, entry-allgather/exit-slice layout moves are
+        deleted, psum accumulates the partial products).  The
+        replicated/sharded books keep the PR 8 ZeRO-residency design —
+        sharded BETWEEN dispatches, replicated inside the program — so
+        their bit-equality contract is untouched."""
+        return self.rules_name == "tp"

@@ -235,6 +235,15 @@ def _perf_row(d: Dict) -> Dict:
                   "arithmetic_intensity", "wall_s_mean"):
             if _num(e.get(k)) is not None:
                 metrics[f"{name}_{k}"] = float(e[k])
+        # collective count/bytes per entry (the tp-vs-sharded
+        # interconnect axis).  Informational, never gated: collective
+        # payload legitimately moves with the model and the rulebook —
+        # the point is that the comparison is machine-READ, the verdict
+        # stays with the learning-curve/throughput bands
+        col = e.get("collectives") or {}
+        for k in ("count", "bytes"):
+            if _num(col.get(k)) is not None:
+                metrics[f"{name}_collective_{k}"] = float(col[k])
     return {"kind": "perf_ledger", "status": "ok", "metrics": metrics,
             "context": {"backend": d.get("backend"), "run": d.get("run"),
                         "ledger_schema": d.get("schema_version")}}

@@ -36,14 +36,27 @@ echo "== serve smoke (AOT policy serving: cold compile -> cache-hit restart) =="
 # cache on every bucket (tools/serve_smoke.py asserts rc, events, hits)
 env JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== multihost smoke (pjit carving bit-equality: replicated vs sharded) =="
-# two fresh-subprocess carving legs over the same 8 virtual CPU devices —
-# one with every param replicated, one with wide matrices genuinely split
-# over mp — must land BIT-identical final learner states (the tool exits
-# nonzero on digest divergence, a failed leg, or a wedged backend, with
-# structured {"status":"failed","reason":...} rows, never a bare tail)
+echo "== multihost smoke (pjit carving bit-equality + tp envelope) =="
+# three fresh-subprocess carving legs — replicated and sharded must land
+# BIT-identical final learner states over the same 8 virtual CPU
+# devices, and the 1x2 tp leg (true tensor-parallel compute, psum
+# partial products) must land inside the bench_diff curve-envelope
+# bands vs those controls (tp never joins the digest set — banded
+# acceptance IS its contract).  The tool exits nonzero on digest
+# divergence, an out-of-band tp leg, a failed leg, or a wedged backend,
+# with structured {"status":"failed","reason":...} rows, never a bare
+# tail
 env JAX_PLATFORMS=cpu python tools/dryrun_multihost.py --mesh-matrix \
-    --legs "8x1:replicated,4x2:sharded" --leg-timeout 420
+    --legs "8x1:replicated,4x2:sharded,1x2:tp" --leg-timeout 420
+
+echo "== tp smoke (tensor-parallel CLI run -> collectives in perf.json + curve gate) =="
+# a tiny real-CLI train run on a 1x2 mesh with --partition-rules tp must
+# rc=0 with run_start recording the tp book, perf.json carrying the
+# partitioned executable's all-reduce count/bytes next to the
+# carving-comparable plain capture, and the curves envelope gating
+# through bench_diff (self-compare rc 0, injected regression rc 1) —
+# tools/tp_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/tp_smoke.py
 
 echo "== mixtopo smoke (mixed-topology batch: 2 networks, one dispatch) =="
 # a tiny 2-episode train run with --topo-mix "schedule,line3" must exit 0

@@ -21,7 +21,14 @@ carvings and partition rulebooks, proving the PR 8 contract end to end:
 - an elastic-resume leg checkpoints a run on an 8-device mesh and
   resumes it in a fresh 4-device process via ``cli train --resume auto``
   (host-gathered checkpoints reshard onto whatever mesh the resuming
-  process builds), asserting the episode counter stays monotone.
+  process builds), asserting the episode counter stays monotone;
+- ``tp`` legs (PR 13: true tensor-parallel compute, psum-accumulated
+  contractions) are EXEMPT from the digest set by design — their
+  acceptance is BANDED: each tp leg's learning-curve envelope
+  (final-window return, AUC) must land inside the bench_diff tolerance
+  bands against the bit-exact control legs (``tools/bench_diff.py``'s
+  ``final_window_return``/``auc_return`` rules — one definition of the
+  band, shared with CI's curve gating).
 
 Both modes follow the bench.py failed-row discipline: every leg runs in
 a fresh subprocess under its own timeout budget, a failure emits a
@@ -50,11 +57,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: default carving matrix: same 8 devices, three carvings, both rulebooks
-#: at the extremes — all final-state digests must agree (the replicated
-#: 8x1 leg doubles as the "rules are a no-op fallback" witness).
+#: default carving matrix: same 8 devices, three carvings, both bit-exact
+#: rulebooks at the extremes — all final-state digests must agree (the
+#: replicated 8x1 leg doubles as the "rules are a no-op fallback"
+#: witness) — plus two tensor-parallel legs whose curves must land
+#: inside the tolerance bands vs those controls (never in the digest
+#: set: tp trades bit-equality for psum-parallel compute).
 DEFAULT_LEGS = ("8x1:replicated,8x1:sharded,4x2:sharded,2x4:sharded,"
-                "1x8:sharded")
+                "1x8:sharded,4x2:tp,2x4:tp")
 LEG_TIMEOUT = 600      # per-leg budget: tiny stack, warm cache is ~1 min
 PROBE_TIMEOUT = 120
 
@@ -122,6 +132,9 @@ def mesh_leg(shape: str, rules: str, episodes: int, replicas: int) -> None:
         "rules": rules, "replicas": replicas, "episodes": episodes,
         "digest": leg["digest"],
         "final_return": round(leg["final_return"], 6),
+        # the whole per-episode curve: tp legs gate on its envelope
+        # (bench_diff bands) instead of joining the digest set
+        "returns": [round(r, 6) for r in leg["returns"]],
         "sharded_leaves": leg["sharded_leaves"],
         "spec_counts": leg["spec_counts"],
         "wall_s": round(time.time() - t0, 1)}), flush=True)
@@ -284,11 +297,71 @@ def elastic_leg(from_mesh: str, to_mesh: str, from_devices: int,
             "wall_s": round(time.time() - t0, 1)}
 
 
+def _curve_envelope(returns) -> dict:
+    """The learning-curve envelope of a leg's per-episode returns —
+    the same two length-robust metrics ``gsc_tpu.obs.curves`` banks
+    (final-window return with w = min(10, len), AUC = mean), computed
+    with plain arithmetic so the launcher stays jax-free."""
+    returns = [float(r) for r in returns or []]
+    if not returns:
+        return {}
+    w = min(10, len(returns))
+    return {"final_window_return": sum(returns[-w:]) / w,
+            "auc_return": sum(returns) / len(returns)}
+
+
+def _gate_tp_legs(tp_legs: list, exact_legs: list) -> list:
+    """Banded acceptance for tp carving legs: each leg's envelope vs
+    the bit-exact control legs' (first ok control), under the SAME
+    tolerance bands bench_diff applies to curves.json rows — one band
+    definition, so this verdict and the CI curve gate can never
+    disagree on what 'inside the envelope' means.  One verdict row per
+    tp leg; an empty list gates nothing (no tp legs requested)."""
+    if not tp_legs:
+        return []
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from bench_diff import metric_rule  # stdlib-only, jax-free
+
+    if not exact_legs:
+        return [{"mesh": r.get("mesh"), "ok": False,
+                 "reason": "no bit-exact control leg to band against"}
+                for r in tp_legs]
+    control = _curve_envelope(exact_legs[0].get("returns"))
+    out = []
+    for leg in tp_legs:
+        env = _curve_envelope(leg.get("returns"))
+        row = {"mesh": leg.get("mesh"), "ok": True,
+               "control_mesh": exact_legs[0].get("mesh")}
+        if not env or not control:
+            row.update(ok=False,
+                       reason="leg or control row carries no returns "
+                              "(pre-PR13 artifact?)")
+            out.append(row)
+            continue
+        for name, base in control.items():
+            higher, tol, floor = metric_rule(name)
+            band = max(tol * abs(base), floor)
+            cur = env[name]
+            delta = (base - cur) if higher else (cur - base)
+            row[name] = {"current": round(cur, 6),
+                         "baseline": round(base, 6),
+                         "band": round(band, 6)}
+            if delta > band:
+                row["ok"] = False
+                row["reason"] = (f"{name} {cur:.6g} outside band "
+                                 f"{band:.6g} of control {base:.6g}")
+        out.append(row)
+    return out
+
+
 def run_matrix(legs: str, episodes: int, replicas: int, n_devices: int,
                leg_timeout: int, elastic: bool, bank: str) -> int:
     """The full round: carving legs (probe-gated, per-leg budgets) +
     optional elastic-resume leg, bit-equality verdict, optional
     MULTICHIP_r*.json artifact."""
+    sys.path.insert(0, REPO)
+    from gsc_tpu.meshspec import (PARTITION_RULEBOOKS,  # jax-free
+                                  validate_partition_rules)
     parsed = []
     for cell in legs.split(","):
         cell = cell.strip()
@@ -296,11 +369,13 @@ def run_matrix(legs: str, episodes: int, replicas: int, n_devices: int,
             continue
         shape, _, rules = cell.partition(":")
         rules = rules or "replicated"
-        if rules not in ("replicated", "sharded"):
+        try:
+            validate_partition_rules(rules)
+        except ValueError:
             print(json.dumps({
                 "status": "failed",
                 "reason": f"leg {cell!r}: rules must be "
-                          "replicated|sharded"}))
+                          + "|".join(PARTITION_RULEBOOKS)}))
             return 2
         parsed.append((shape, rules))
 
@@ -346,21 +421,36 @@ def run_matrix(legs: str, episodes: int, replicas: int, n_devices: int,
 
     ok_carvings = [r for r in rows
                    if r.get("leg") == "carving" and r["status"] == "ok"]
-    digests = {r["digest"] for r in ok_carvings}
+    # tp legs trade bit-equality for psum-parallel compute: they NEVER
+    # join the digest set — they gate on the curve-envelope bands below
+    exact = [r for r in ok_carvings if r.get("rules") != "tp"]
+    tp_legs = [r for r in ok_carvings if r.get("rules") == "tp"]
+    digests = {r["digest"] for r in exact}
     sharded_proven = any(r.get("sharded_leaves", 0) > 0
                          for r in ok_carvings)
     all_ok = all(r["status"] == "ok" for r in rows)
-    bit_equal = len(ok_carvings) == len(
-        [r for r in rows if r.get("leg") == "carving"]) \
-        and len(digests) == 1
+    exact_requested = [r for r in rows if r.get("leg") == "carving"
+                       and r.get("rules") != "tp"]
+    # a tp-ONLY matrix has no digest claim to make — bit-equality is
+    # vacuously true and the tp gate below reports the real problem
+    # ("no bit-exact control leg to band against"), not an empty set
+    bit_equal = len(exact) == len(exact_requested) \
+        and (len(digests) == 1 if exact_requested else True)
+    tp_verdicts = _gate_tp_legs(tp_legs, exact)
+    tp_clean = all(v["ok"] for v in tp_verdicts)
     verdict = {
-        "status": "ok" if (all_ok and bit_equal) else "failed",
+        "status": "ok" if (all_ok and bit_equal and tp_clean)
+        else "failed",
         "mode": "mesh_matrix", "devices": n_devices,
         "legs_ok": len([r for r in rows if r["status"] == "ok"]),
         "legs_total": len(rows),
         "bit_equal_across_carvings": bit_equal,
         "sharded_params_proven": sharded_proven,
     }
+    if tp_legs:
+        verdict["tp_legs"] = len(tp_legs)
+        verdict["tp_within_band"] = tp_clean
+        verdict["tp_envelope"] = tp_verdicts
     if not all_ok:
         verdict["reason"] = "; ".join(
             f"{r.get('mesh', r.get('leg'))}: {r['reason']}"
@@ -368,6 +458,10 @@ def run_matrix(legs: str, episodes: int, replicas: int, n_devices: int,
     elif not bit_equal:
         verdict["reason"] = (f"final-state digests diverge across "
                              f"carvings: {sorted(digests)}")
+    elif not tp_clean:
+        verdict["reason"] = "; ".join(
+            f"tp {v['mesh']}: {v['reason']}"
+            for v in tp_verdicts if not v["ok"])[:500]
     print(json.dumps(verdict), flush=True)
     if bank:
         artifact = {**verdict, "ok": verdict["status"] == "ok",

@@ -205,6 +205,7 @@ def perf_summary(perf: Optional[Dict]) -> Optional[Dict]:
             rows[name] = {"available": False, "error": (e or {}).get("error")}
             continue
         roof = e.get("roofline") or {}
+        col = e.get("collectives") or {}
         rows[name] = {
             "flops": e.get("flops"),
             "bytes_accessed": e.get("bytes_accessed"),
@@ -215,6 +216,11 @@ def perf_summary(perf: Optional[Dict]) -> Optional[Dict]:
             "mfu": e.get("mfu"),
             "regime": roof.get("regime"),
             "roof_multiple": roof.get("roof_multiple"),
+            # cross-device movers per call (partitioned executables
+            # only; 0 on single-device programs, absent on pre-PR13
+            # ledgers) — the tp-vs-sharded interconnect columns
+            "collective_count": col.get("count"),
+            "collective_bytes": col.get("bytes"),
         }
     phases = perf.get("phases") or {}
     dispatch_s = (phases.get("dispatch") or {}).get("total_s") or 0.0
@@ -608,6 +614,7 @@ def render_text(summary: Dict, out=sys.stdout):
     if perf and perf.get("entries"):
         w("\nperf (device-cost ledger, per watched entry point):\n")
         w(f"  {'entry':<20} {'flops':>12} {'bytes':>12} {'fusions':>8} "
+          f"{'coll':>6} {'coll_B':>10} "
           f"{'disp':>6} {'wall_ms':>9} {'mfu':>10} {'regime':<14} "
           f"{'roof_x':>8}\n")
         for name, r in perf["entries"].items():
@@ -618,6 +625,8 @@ def render_text(summary: Dict, out=sys.stdout):
             w(f"  {name:<20} {_fmt(r.get('flops'), 12)} "
               f"{_fmt(r.get('bytes_accessed'), 12)} "
               f"{_fmt(r.get('fusions'), 8)} "
+              f"{_fmt(r.get('collective_count'), 6)} "
+              f"{_fmt(r.get('collective_bytes'), 10)} "
               f"{_fmt(r.get('dispatches'), 6)} "
               f"{_fmt(r.get('wall_ms_mean'), 9)} "
               f"{r.get('mfu') if r.get('mfu') is not None else '-':>10} "
@@ -872,12 +881,23 @@ def _synthetic_perf(path: str):
                     "available": True, "flops": 6668188.0,
                     "bytes_accessed": 6770940.0, "fusions": 718,
                     "ops": {"while": 21, "dot": 167},
+                    "collectives": {"ops": {}, "count": 0, "bytes": 0},
                     "arithmetic_intensity": 0.9848,
                     "dispatches": 5, "wall_s_total": 0.05,
                     "wall_s_mean": 0.01, "mfu": 0.0133,
                     "roofline": {"intensity": 0.9848, "ridge": 2.5,
                                  "regime": "memory_bound",
                                  "roof_multiple": 29.5}},
+                "chunk_step_sharded": {
+                    "available": True, "flops": 6668188.0,
+                    "bytes_accessed": 6770940.0, "fusions": 731,
+                    "ops": {"while": 21, "dot": 167},
+                    # a partitioned executable: the tp interconnect
+                    # columns the report must surface
+                    "collectives": {
+                        "ops": {"all-reduce": {"count": 6,
+                                               "bytes": 73728}},
+                        "count": 6, "bytes": 73728}},
                 "serve_policy_b8": {"available": False,
                                     "error": "RuntimeError: no backend"},
             },
@@ -903,6 +923,12 @@ def selftest() -> int:
         assert row["fusions"] == 718 and row["mfu"] == 0.0133 \
             and row["regime"] == "memory_bound" \
             and row["wall_ms_mean"] == 10.0, row
+        # the interconnect columns: 0 on the single-device entry, the
+        # partitioned executable's all-reduce payload on the sharded one
+        assert row["collective_count"] == 0, row
+        sh = pf["entries"]["chunk_step_sharded"]
+        assert sh["collective_count"] == 6 \
+            and sh["collective_bytes"] == 73728, sh
         assert pf["entries"]["serve_policy_b8"]["available"] is False
         assert pf["device_vs_host"] == {"dispatch_s": 0.05,
                                         "host_s": 0.01}, pf
@@ -954,6 +980,9 @@ def selftest() -> int:
         assert "perf (device-cost ledger" in txt.getvalue() \
             and "memory_bound" in txt.getvalue(), \
             "perf section not rendered"
+        assert "coll_B" in txt.getvalue() \
+            and "73728" in txt.getvalue(), \
+            "collective count/bytes columns not rendered"
         assert "no HBM data" in txt.getvalue(), \
             "memory-unavailable note not rendered"
         assert "mesh: 4x2  rules: sharded" in txt.getvalue(), \
