@@ -452,7 +452,8 @@ class Trainer:
               init_state: Optional[DDPGState] = None,
               init_buffer=None, start_episode: int = 0,
               pipeline: bool = True, ckpt_manager=None,
-              ckpt_interval: int = 0, preempt=None):
+              ckpt_interval: int = 0, preempt=None,
+              publisher=None, publish_interval: int = 0):
         """Train through episode ``episodes - 1`` (train-at-episode-end
         schedule, simple_ddpg.py:280-329).  Returns (final learner state,
         replay buffer).  With ``profile`` a jax profiler trace of the run is
@@ -489,7 +490,20 @@ class Trainer:
           checkpoints of the last VERIFIED state;
         - ``preempt`` (a resilience.PreemptionGuard) stops the loop at the
           next episode boundary after SIGTERM/SIGINT — the caller then
-          snapshots ``(state, buffer)`` at ``self.completed_episodes``."""
+          snapshots ``(state, buffer)`` at ``self.completed_episodes``.
+
+        Train-while-serve: ``publisher`` (a
+        :class:`~gsc_tpu.serve.fleet.WeightPublisher`) + a positive
+        ``publish_interval`` publish the actor params as a versioned
+        hot-swap artifact every N drained-finite episodes — a
+        concurrently running serving fleet's VersionWatchers pick each
+        version up between dispatches.  With the rollback guard on
+        (default), what ships is the guard's VERIFIED snapshot — the
+        same state a periodic checkpoint saves — so a poisoned state is
+        never published (the live carry is one dispatch ahead and
+        unverified).  ``Trainer(rollback=False)`` has no verified
+        snapshot and falls back to the live params.  Host gather at
+        checkpoint-like cadence, never on the per-episode path."""
         if getattr(self.driver, "topo_mix", None):
             # the mix fills a replica axis this path does not have —
             # silently training one topology would fake mixture coverage
@@ -507,7 +521,8 @@ class Trainer:
                                   pipeline=pipeline,
                                   ckpt_manager=ckpt_manager,
                                   ckpt_interval=ckpt_interval,
-                                  preempt=preempt)
+                                  preempt=preempt, publisher=publisher,
+                                  publish_interval=publish_interval)
         self.phase_timer = timer = PhaseTimer()
         hub = self.obs.hub if self.obs else None
         base = jax.random.PRNGKey(self.seed)
@@ -679,6 +694,31 @@ class Trainer:
                             _, g_state, g_buffer = guard.last_good
                             ckpt_manager.save(g_state, g_buffer,
                                               episode=k + 1)
+                    if (publisher is not None and publish_interval
+                            and (k + 1 - start_episode)
+                            % publish_interval == 0):
+                        # hot-swap publish: with the guard on, ship the
+                        # VERIFIED snapshot the promote above just
+                        # landed (state after episode k) — the live
+                        # carry is up to one dispatch ahead and its
+                        # finite flag has NOT drained yet, so publishing
+                        # it could ship a poisoned state one episode
+                        # before rollback catches it (the periodic
+                        # checkpoint above refuses that for the same
+                        # reason).  Rollback disabled = no verified
+                        # snapshot exists; fall back to the live params
+                        # (this drain's flag was finite, the next
+                        # dispatch's is anyone's guess — documented).
+                        src = None
+                        if guard is not None:
+                            if guard.last_good is not None \
+                                    and guard.last_good[0] == k:
+                                src = guard.last_good[1].actor_params
+                        else:
+                            src = state.actor_params
+                        if src is not None:
+                            publisher.publish(jax.device_get(src),
+                                              meta={"episode": k + 1})
                     return
                 if guard is None:
                     self._recover(

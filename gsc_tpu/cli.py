@@ -407,6 +407,17 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--ckpt-retain", default=3, show_default=True,
               help="periodic checkpoints kept on disk (the last-good "
                    "pointer target is never pruned)")
+@click.option("--hot-swap-dir", default=None,
+              help="train-while-serve: publish the live actor params as "
+                   "versioned, fingerprint-keyed hot-swap artifacts "
+                   "(serve.fleet.WeightPublisher) into this directory "
+                   "every --publish-interval drained-finite episodes — a "
+                   "concurrently running `cli serve --hot-swap-dir` "
+                   "fleet swaps each version in between dispatches.  "
+                   "Single-env path only (--replicas 1)")
+@click.option("--publish-interval", default=1, show_default=True,
+              help="episodes between hot-swap weight publishes "
+                   "(with --hot-swap-dir)")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
@@ -417,7 +428,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           obs_rotate_mb, perf_enabled, learnobs_enabled, metrics_port,
           watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
-          ckpt_retain, jax_cache_dir, verbose):
+          ckpt_retain, hot_swap_dir, publish_interval, jax_cache_dir,
+          verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
     (src/rlsp/agents/main.py:89-113 semantics).  With --replicas B, each
@@ -450,6 +462,15 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         # same contract as bench.py's --unroll: fail fast with the flag's
         # name, not a SimConfig traceback from deep inside the run loop
         raise click.BadParameter("--unroll must be a positive integer")
+    if hot_swap_dir and replicas > 1:
+        # the publish hook lives in the single-env drain (the parallel
+        # path's state is replica/mesh-sharded — publishing it needs the
+        # plan's gather fns, which is the checkpoint path's job)
+        raise click.BadParameter("--hot-swap-dir publishes from the "
+                                 "single-env loop — drop --replicas or "
+                                 "serve from periodic checkpoints instead")
+    if publish_interval < 1:
+        raise click.BadParameter("--publish-interval must be >= 1")
     plan = None
     if mesh:
         # build the plan BEFORE any other jax work so the mesh binds the
@@ -620,6 +641,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                             "unroll": env.sim_cfg.scan_unroll,
                             "result_dir": rdir,
                             "ckpt_interval": ckpt_interval,
+                            "hot_swap_dir": hot_swap_dir,
                             "jax_cache_dir": jax_cache_dir,
                             **mesh_meta,
                             **({"fault_plan": fplan.summary()} if fplan
@@ -694,12 +716,20 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                         ckpt_manager=manager, ckpt_interval=ckpt_interval,
                         preempt=guard, plan=plan)
                 else:
+                    publisher = None
+                    if hot_swap_dir:
+                        from .serve.fleet import WeightPublisher
+                        publisher = WeightPublisher(
+                            hot_swap_dir,
+                            hub=(obs.hub if obs is not None else None))
                     state, buffer = trainer.train(
                         episodes, verbose=verbose, profile=profile,
                         init_state=init_state, init_buffer=init_buffer,
                         start_episode=start_episode, pipeline=pipeline,
                         ckpt_manager=manager, ckpt_interval=ckpt_interval,
-                        preempt=guard)
+                        preempt=guard, publisher=publisher,
+                        publish_interval=(publish_interval
+                                          if hot_swap_dir else 0))
             result.runtime_stop("train")
 
             if trainer.preempted:
@@ -827,7 +857,53 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
                    "smallest bucket that fits it")
 @click.option("--deadline-ms", default=5.0, show_default=True,
               help="max wait before a partially-filled batch flushes (the "
-                   "latency a lone request pays for batching)")
+                   "latency a lone request pays for batching; with "
+                   "--continuous it only bounds SLO deadline-miss "
+                   "accounting — continuous batching never waits it out)")
+@click.option("--continuous", is_flag=True, default=False,
+              help="continuous batching: the next batch is formed while "
+                   "the current device call is in flight and dispatches "
+                   "the moment the device frees — requests join the next "
+                   "dispatch instead of waiting out --deadline-ms.  "
+                   "Latency-optimal at low rate (a lone request never "
+                   "idles a deadline away), batch-optimal under load "
+                   "(the in-flight backlog becomes the next batch).  "
+                   "Default: the historic deadline batcher")
+@click.option("--workers", default=1, show_default=True,
+              help="serving fleet size: N PolicyServer replicas behind "
+                   "least-queue-depth dispatch, every serve metric "
+                   "tagged worker=w<i>.  A learned-tier fleet also gets "
+                   "an SPR brownout tier that absorbs overflow (full "
+                   "worker queue, or SLO budget burn past "
+                   "--brownout-burn with a backlog) instead of "
+                   "rejecting.  1 = the historic single server")
+@click.option("--brownout-burn", default=2.0, show_default=True,
+              help="error-budget burn rate above which a backlogged "
+                   "fleet sheds new load to the SPR tier (needs "
+                   "--workers > 1, a checkpoint and --slo-p99-ms; "
+                   "0 disables proactive shedding — overflow shedding "
+                   "on a full queue stays on)")
+@click.option("--hot-swap-dir", default=None,
+              help="live weight hot-swap: watch this publish directory "
+                   "(serve.fleet.WeightPublisher layout — cli train "
+                   "--hot-swap-dir writes it) and swap newly published "
+                   "weight versions in BETWEEN device dispatches, zero "
+                   "requests dropped, no batch ever mixing versions; "
+                   "every serve_flush event/span carries the "
+                   "policy_version that answered it")
+@click.option("--swap-poll-s", default=0.2, show_default=True,
+              help="seconds between hot-swap directory polls")
+@click.option("--fire-swaps", default=0, show_default=True,
+              help="self-test/bench hook: publish this many weight "
+                   "versions into --hot-swap-dir WHILE the synthetic "
+                   "load runs (spaced across the request count), so "
+                   "hot-swap-under-fire is measurable from one command.  "
+                   "The published payload is the serving tier's own "
+                   "current weights (learned: the restored actor params; "
+                   "SPR: the precomputed schedule action), so answers "
+                   "stay bit-stable while the full swap path — publish, "
+                   "watch, validate, lock, swap, stamp — executes under "
+                   "load")
 @click.option("--artifact-cache", default=None,
               help="compiled-policy artifact cache dir (serialized "
                    "jax.export modules keyed by checkpoint fingerprint + "
@@ -886,11 +962,12 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
                    "regardless).  Requires --obs")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 def serve(agent_config, simulator_config, service, scheduler, checkpoint,
-          requests, concurrency, buckets, deadline_ms, artifact_cache,
-          pool_steps, stats_interval, request_timeout, seed, max_nodes,
-          max_edges, resource_functions_path, result_dir, obs_enabled,
-          obs_dir, perf_enabled, metrics_port, trace_sample, slo_p99_ms,
-          jax_cache_dir):
+          requests, concurrency, buckets, deadline_ms, continuous,
+          workers, brownout_burn, hot_swap_dir, swap_poll_s, fire_swaps,
+          artifact_cache, pool_steps, stats_interval, request_timeout,
+          seed, max_nodes, max_edges, resource_functions_path, result_dir,
+          obs_enabled, obs_dir, perf_enabled, metrics_port, trace_sample,
+          slo_p99_ms, jax_cache_dir):
     """Serve coordination decisions from an AOT-compiled greedy policy.
 
     With CHECKPOINT: restores the actor, ahead-of-time compiles the
@@ -899,6 +976,13 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     from minutes to seconds), then answers micro-batched requests.
     Without CHECKPOINT: the SPR shortest-path heuristic serves as the
     non-learned fallback tier through the same queue and accounting.
+
+    Fleet mode (--workers N) runs N server replicas behind
+    least-queue-depth dispatch with an SPR brownout tier;
+    --hot-swap-dir makes every worker watch a weight-publish directory
+    (written by a concurrent `cli train --hot-swap-dir` run) and swap
+    new policy versions in between dispatches — train-while-serve with
+    zero dropped requests across a swap.
 
     This command drives itself with a synthetic closed-loop request load
     (--requests/--concurrency over a pool of real observations) and
@@ -911,8 +995,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     import numpy as _np
 
     from .agents.ddpg import DDPG
-    from .serve import (ArtifactCache, GreedyServePolicy, PolicyServer,
-                        SPRFallbackPolicy)
+    from .serve import (ArtifactCache, FleetDispatcher, GreedyServePolicy,
+                        PolicyServer, SPRFallbackPolicy)
     from .utils.experiment import setup_result_dir
 
     try:
@@ -926,6 +1010,15 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     if requests < 1 or concurrency < 1:
         raise click.BadParameter("--requests and --concurrency must be "
                                  "positive")
+    if workers < 1:
+        raise click.BadParameter("--workers must be >= 1")
+    if fire_swaps < 0:
+        raise click.BadParameter("--fire-swaps must be >= 0")
+    if fire_swaps and not hot_swap_dir:
+        raise click.BadParameter("--fire-swaps publishes into the hot-"
+                                 "swap directory — pass --hot-swap-dir")
+    if swap_poll_s <= 0:
+        raise click.BadParameter("--swap-poll-s must be > 0")
     if metrics_port < 0:
         raise click.BadParameter("--metrics-port must be >= 0 "
                                  "(0 = disabled)")
@@ -986,6 +1079,9 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
             "mode": "serve", "tier": tier, "seed": seed,
             "requests": requests, "concurrency": concurrency,
             "buckets": list(bucket_sizes), "deadline_ms": deadline_ms,
+            "batch_mode": "continuous" if continuous else "deadline",
+            "workers": workers, "hot_swap_dir": hot_swap_dir,
+            "fire_swaps": fire_swaps,
             "trace_sample": trace_sample, "slo_p99_ms": slo_p99_ms,
             "precision": agent.precision,
             "substep_impl": env.sim_cfg.substep_impl,
@@ -1004,15 +1100,25 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
     # request-path tracing + SLO engine ride the observer: flush spans
     # and decomposition always recorded under --obs, request spans
     # head-sampled by --trace-sample, slo.json written at close.  With
-    # --no-obs the server runs the historic tracer-free path.
-    tracer = None
-    slo_path = None
-    if obs_rec is not None:
-        from .obs import ServeTracer
-        tracer = ServeTracer(hub=hub, sample=trace_sample)
-        slo_path = obs_rec.slo_path
+    # --no-obs the server runs the historic tracer-free path.  Fleet
+    # workers each get their OWN tracer (a tracer binds one SLO engine);
+    # they share the hub, so the histograms/events merge fleet-wide.
+    slo_path = obs_rec.slo_path if obs_rec is not None else None
 
+    def make_tracer():
+        if obs_rec is None:
+            return None
+        from .obs import ServeTracer
+        return ServeTracer(hub=hub, sample=trace_sample)
+
+    mode = "continuous" if continuous else "deadline"
+    common = dict(buckets=bucket_sizes, deadline_ms=deadline_ms, hub=hub,
+                  stats_interval=stats_interval, mode=mode,
+                  hot_swap_dir=hot_swap_dir, swap_poll_s=swap_poll_s,
+                  slo=slo_objectives)
     try:
+        spr_fallback = lambda: SPRFallbackPolicy(topo, env.limits, obs0)
+        swap_payload = None   # what --fire-swaps publishes
         if checkpoint:
             from .utils.checkpoint import (checkpoint_fingerprint,
                                            load_full_or_partial)
@@ -1022,25 +1128,97 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
                 checkpoint, example, example_buffer=example_buffer,
                 example_extra={"episode": _np.asarray(0, _np.int32)}
             )[0]["state"]
-            server = PolicyServer(
+            learned = dict(
                 policy=GreedyServePolicy(ddpg, obs0),
                 params=state.actor_params,
-                buckets=bucket_sizes, deadline_ms=deadline_ms,
                 cache=ArtifactCache(cache_dir),
                 fingerprint=checkpoint_fingerprint(checkpoint),
                 precision=agent.precision,
                 substep_impl=env.sim_cfg.substep_impl,
-                graph_mode=agent.graph_mode, hub=hub,
-                stats_interval=stats_interval,
-                perf=(obs_rec.perf if obs_rec is not None else None),
-                tracer=tracer, slo=slo_objectives, slo_path=slo_path)
+                graph_mode=agent.graph_mode)
+            swap_payload = jax.device_get(state.actor_params)
+            if workers == 1:
+                frontend = server = PolicyServer(
+                    **common, **learned,
+                    perf=(obs_rec.perf if obs_rec is not None else None),
+                    tracer=make_tracer(), slo_path=slo_path)
+            else:
+                # the cost ledger rides worker 0 only: the per-bucket
+                # compile capture is identical across workers, and the
+                # serve_batch_ms histogram it merges at close is the
+                # fleet aggregate already
+                fleet = [PolicyServer(
+                    **common, **learned, worker=f"w{i}",
+                    perf=(obs_rec.perf if obs_rec is not None and i == 0
+                          else None),
+                    tracer=make_tracer()) for i in range(workers)]
+                brownout = PolicyServer(
+                    fallback=spr_fallback(), buckets=bucket_sizes,
+                    deadline_ms=deadline_ms, hub=hub, worker="spr",
+                    mode=mode, stats_interval=stats_interval,
+                    tracer=make_tracer(), slo=slo_objectives)
+                frontend = FleetDispatcher(
+                    fleet, spr=brownout, hub=hub,
+                    brownout_burn=(brownout_burn or None))
+                server = fleet[0]
         else:
-            server = PolicyServer(
-                fallback=SPRFallbackPolicy(topo, env.limits, obs0),
-                buckets=bucket_sizes, deadline_ms=deadline_ms, hub=hub,
-                stats_interval=stats_interval,
-                tracer=tracer, slo=slo_objectives, slo_path=slo_path)
-        server.start()
+            if workers == 1:
+                frontend = server = PolicyServer(
+                    **common, fallback=spr_fallback(),
+                    tracer=make_tracer(), slo_path=slo_path)
+            else:
+                # an SPR fleet IS the bottom tier — no brownout target
+                # below it; overflow rejects like the single server would
+                fleet = [PolicyServer(
+                    **common, fallback=spr_fallback(), worker=f"w{i}",
+                    tracer=make_tracer()) for i in range(workers)]
+                frontend = FleetDispatcher(fleet, hub=hub,
+                                           brownout_burn=None)
+                server = fleet[0]
+            if hot_swap_dir:
+                # the SPR tier's "weights" are its precomputed schedule
+                # action — what a fired swap republishes
+                swap_payload = [_np.asarray(server.fallback.action)]
+        frontend.start()
+
+        # --fire-swaps: publish K versions of the CURRENT weights while
+        # the load runs, spaced across the request count — the workers'
+        # VersionWatchers must pick every one up under fire with zero
+        # dropped requests (tools/fleet_smoke.py and serve_bench's
+        # SERVE_r02 swap leg assert exactly that)
+        fire_stop = threading.Event()
+        fire_thread = None
+        publisher = None
+        if fire_swaps:
+            from .serve.fleet import WeightPublisher
+            publisher = WeightPublisher(hot_swap_dir, hub=hub)
+            targets = [max(1, int(requests * (i + 1) / (fire_swaps + 1)))
+                       for i in range(fire_swaps)]
+            if workers > 1:
+                adopted = lambda: min(w.policy_version for w in fleet)
+            else:
+                adopted = lambda: server.policy_version
+
+            def _fire():
+                # each publish waits for the PREVIOUS version to be
+                # adopted by every worker: the watcher (correctly)
+                # swaps straight to the newest version, so back-to-back
+                # publishes within one poll interval would coalesce
+                # into a single swap and undercount the exercised path
+                fired = 0
+                while fired < len(targets) and not fire_stop.is_set():
+                    done = hub.get_counter("serve_requests_total")
+                    if done >= targets[fired] \
+                            and adopted() >= publisher.version:
+                        publisher.publish(swap_payload,
+                                          meta={"fired_at": int(done)})
+                        fired += 1
+                    else:
+                        fire_stop.wait(0.003)
+
+            fire_thread = threading.Thread(target=_fire, daemon=True,
+                                           name="gsc-swap-firer")
+            fire_thread.start()
 
         # closed-loop load: each client thread submits its share
         # sequentially, so at most --concurrency requests are in flight
@@ -1053,7 +1231,7 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
             for j in range(n):
                 ob_h = pool[(tid + j * concurrency) % len(pool)]
                 try:
-                    server.submit(ob_h).result(request_timeout)
+                    frontend.submit(ob_h).result(request_timeout)
                 except Exception as e:  # noqa: BLE001 - surfaced in JSON
                     errors.append(f"client{tid}/{j}: {e}")
 
@@ -1065,6 +1243,23 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
         for t in threads:
             t.join()
         wall = _time.perf_counter() - t0
+        if fire_thread is not None:
+            # let the firer finish its remaining publishes (adoption-
+            # gated, so this is at most a few poll periods) before the
+            # backstop stop
+            fire_thread.join(timeout=10.0)
+            fire_stop.set()
+            fire_thread.join(timeout=5.0)
+            # bounded wait for the watchers to adopt the last published
+            # version, so the JSON's swap count is deterministic (the
+            # load is done; this costs at most a few poll periods)
+            swap_total = (frontend.swap_total if workers > 1
+                          else lambda: server.swaps)
+            want = publisher.version * (workers if workers > 1 else 1)
+            deadline_wait = _time.perf_counter() + 5.0
+            while swap_total() < want \
+                    and _time.perf_counter() < deadline_wait:
+                _time.sleep(swap_poll_s / 4)
         lat = server.latency_summary() or {}
         per_bucket = {}
         for b in bucket_sizes:
@@ -1074,7 +1269,36 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
                     "requests": int(s["count"]),
                     "p50_ms": round(s["p50"], 3),
                     "p99_ms": round(s["p99"], 3)}
-        server.close()
+        swaps = frontend.swap_total() if workers > 1 else server.swaps
+        brownout_counts = None
+        if workers > 1:
+            brownout_counts = {
+                reason: int(hub.get_counter("serve_brownout_total",
+                                            reason=reason))
+                for reason in ("slo_burn", "overflow")}
+        frontend.close()
+        # AFTER close: the tracer's final synchronous drain runs inside
+        # close(), so the engine has seen every flush — reading earlier
+        # under-reports fast runs (the drainer thread ticks at 50 ms)
+        slo_block = (frontend.slo_summary() if workers > 1
+                     else server.slo_summary())
+        if workers > 1 and slo_path is not None \
+                and frontend.merged_slo() is not None:
+            # the fleet's slo.json: merged engine snapshots + fleet-wide
+            # latency percentiles (same schema bench_diff's slo rows
+            # ingest; per-worker numbers ride under per_worker)
+            from .obs.slo import SLO_SCHEMA_VERSION, write_slo_json
+            merged = frontend.merged_slo()
+            write_slo_json(slo_path, {
+                "schema_version": SLO_SCHEMA_VERSION,
+                "ts": round(_time.time(), 3),
+                "run": hub.base_tags.get("run"),
+                "tier": server.tier,
+                "buckets": list(bucket_sizes),
+                "requests_completed": frontend.completed,
+                "p50_latency_ms": round(lat.get("p50", 0.0), 4),
+                "p99_latency_ms": round(lat.get("p99", 0.0), 4),
+                **merged})
     except BaseException:
         if obs_rec is not None:
             try:
@@ -1086,13 +1310,18 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
         obs_rec.close(status="ok")
     click.echo(json.dumps({
         "tier": server.tier, "requests": requests,
+        "workers": workers, "mode": mode,
         "errors": len(errors), "error_detail": errors[:5],
         "wall_s": round(wall, 3),
         "rps": round(requests / wall, 3) if wall > 0 else 0.0,
         "p50_ms": round(lat.get("p50", 0.0), 3),
         "p99_ms": round(lat.get("p99", 0.0), 3),
         "buckets": per_bucket,
-        "slo": server.slo_summary(),
+        "slo": slo_block,
+        "swaps": swaps,
+        "published_versions": (publisher.version if publisher else 0),
+        "policy_version": server.policy_version,
+        "brownout": brownout_counts,
         "startup": server.startup,
         "artifact_cache": cache_dir if checkpoint else None,
         "jax_cache_dir": jax_cache_dir,

@@ -144,10 +144,15 @@ class SLOEngine:
     def __init__(self, deadline_ms: float,
                  objectives: Optional[SLOObjectives] = None,
                  hub=None, window: int = _SLO_WINDOW,
-                 alpha: float = _ARRIVAL_ALPHA):
+                 alpha: float = _ARRIVAL_ALPHA,
+                 tags: Optional[Dict[str, str]] = None):
         self.deadline_ms = float(deadline_ms)
         self.objectives = objectives or SLOObjectives()
         self.hub = hub
+        # extra gauge/counter tags (e.g. {"worker": "w0"} in a fleet, so
+        # N engines sharing one hub never fight over the slo_* series);
+        # empty = the historic untagged series
+        self.tags = dict(tags or {})
         self.alpha = float(alpha)
         self._lock = threading.Lock()
         # (latency_ms, bucket) rolling window for attainment
@@ -307,12 +312,13 @@ class SLOEngine:
                           ("slo_pad_waste", "pad_waste"),
                           ("slo_queue_wait_frac", "queue_wait_frac")):
             if snap.get(key) is not None:
-                self.hub.gauge(name, snap[key])
+                self.hub.gauge(name, snap[key], **self.tags)
         with self._lock:
             delta = self._deadline_misses - self._published_misses
             self._published_misses = self._deadline_misses
         if delta:
-            self.hub.counter("serve_deadline_miss_total", delta)
+            self.hub.counter("serve_deadline_miss_total", delta,
+                             **self.tags)
 
 
 class ServeTracer:
@@ -440,6 +446,15 @@ class ServeTracer:
         pad_fraction = round(1.0 - n_real / bucket, 6) if bucket else 0.0
         flush_id = self._flush_seq
         self._flush_seq += 1
+        # fleet/hot-swap context: the policy version the device call ran
+        # under (stamped under the batcher's flush lock, so it is exact)
+        # and the worker id — both ride every serve_flush event and span
+        # when the batcher declares them (None/absent otherwise)
+        extra = {}
+        if rec.get("policy_version") is not None:
+            extra["policy_version"] = rec["policy_version"]
+        if rec.get("worker"):
+            extra["worker"] = rec["worker"]
         if self.engine is not None:
             self.engine.record_flush(n_real, bucket)
         if rec.get("error") is not None:
@@ -459,7 +474,7 @@ class ServeTracer:
                                n_real=n_real, pad_fraction=pad_fraction,
                                device_ms=round(device_ms, 4),
                                queue_depth=rec.get("queue_depth"),
-                               error=rec["error"])
+                               error=rec["error"], **extra)
             return
         spans = []
         for (trace_id, wall_enq, t_enq, t_admit, t_done) in rec["requests"]:
@@ -492,6 +507,7 @@ class ServeTracer:
                     "fanout_ms": round(fanout_ms, 4),
                     "latency_ms": round(latency_ms, 4),
                     "deadline_miss": miss,
+                    **extra,
                 })
         if self.hub is not None:
             # flush-level span: ALWAYS recorded (one per device call);
@@ -501,7 +517,7 @@ class ServeTracer:
                            flush_id=flush_id, bucket=bucket, n_real=n_real,
                            pad_fraction=pad_fraction,
                            device_ms=round(device_ms, 4),
-                           queue_depth=rec.get("queue_depth"))
+                           queue_depth=rec.get("queue_depth"), **extra)
             for span in spans:
                 self.hub.event("serve_request_span", **span)
 

@@ -9,16 +9,19 @@ streaming p50/p99 latency through the run's MetricsHub.  Without a
 checkpoint the SPR shortest-path heuristic serves as the non-learned
 fallback tier.
 """
-from .batcher import MicroBatcher, ServeError, ServeFuture
+from .batcher import BATCH_MODES, MicroBatcher, ServeError, ServeFuture
 from .cache import ArtifactCache, cache_material
 from .fallback import SPRFallbackPolicy, spr_schedule_action
+from .fleet import (FleetDispatcher, VersionWatcher, WeightPublisher,
+                    params_fingerprint)
 from .policy import (GreedyServePolicy, ObsTemplate, exec_fn_name,
                      policy_fn_name)
 from .server import PolicyServer
 
 __all__ = [
-    "ArtifactCache", "GreedyServePolicy", "MicroBatcher", "ObsTemplate",
-    "PolicyServer", "SPRFallbackPolicy", "ServeError", "ServeFuture",
-    "cache_material", "exec_fn_name", "policy_fn_name",
-    "spr_schedule_action",
+    "ArtifactCache", "BATCH_MODES", "FleetDispatcher", "GreedyServePolicy",
+    "MicroBatcher", "ObsTemplate", "PolicyServer", "SPRFallbackPolicy",
+    "ServeError", "ServeFuture", "VersionWatcher", "WeightPublisher",
+    "cache_material", "exec_fn_name", "params_fingerprint",
+    "policy_fn_name", "spr_schedule_action",
 ]

@@ -74,6 +74,10 @@ class ArtifactCache:
     def __init__(self, root: str):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # keys this process served from or wrote this session — prune()
+        # never deletes them, so GC can't evict the entry a live server
+        # is (or just started) running on
+        self._active: set = set()
 
     @staticmethod
     def key_of(material: Dict) -> str:
@@ -108,11 +112,13 @@ class ArtifactCache:
             return None
         try:
             with open(blob_path, "rb") as f:
-                return f.read()
+                blob = f.read()
         except OSError as e:
             log.warning("serve artifact unreadable — recompiling: "
                         "path=%s error=%s", blob_path, e)
             return None
+        self._active.add(self.key_of(material))
+        return blob
 
     def store(self, material: Dict, blob: bytes) -> str:
         """Atomic write of blob + meta; returns the blob path."""
@@ -131,4 +137,52 @@ class ArtifactCache:
         from ..obs.sinks import write_atomic_json
         write_atomic_json(meta_path, {"material": material,
                                       "bytes": len(blob)})
+        self._active.add(self.key_of(material))
         return blob_path
+
+    def prune(self, keep_latest: int, protect=()) -> list:
+        """GC stale entries: keep the ``keep_latest`` most recently
+        written blobs (mtime order), delete the rest — hot-swap
+        publishing mints one artifact set per checkpoint fingerprint, so
+        a long train-while-serve run would otherwise grow the cache one
+        generation per published version.
+
+        Never deletes an entry this process loaded or stored
+        (``self._active``) or one in ``protect`` (explicit keys).  A
+        half-entry — blob without meta (torn write) or meta without blob
+        (a previously interrupted prune) — counts as an entry and is
+        collectable like any other.  Deletion order is meta first, then
+        blob: a concurrent ``load`` that still sees the blob reads a
+        missing meta and treats it as a miss, never a half-valid hit.
+        Returns the pruned keys."""
+        if keep_latest < 0:
+            raise ValueError(f"keep_latest must be >= 0: {keep_latest}")
+        protected = self._active | set(protect)
+        entries = {}
+        for path in os.listdir(self.root):
+            key, ext = os.path.splitext(path)
+            if ext not in (".stablehlo", ".json"):
+                continue
+            full = os.path.join(self.root, path)
+            try:
+                mtime = os.path.getmtime(full)
+            except OSError:
+                continue   # deleted under us (concurrent prune)
+            entries[key] = max(entries.get(key, 0.0), mtime)
+        keep = sorted(entries, key=lambda k: entries[k],
+                      reverse=True)[:keep_latest]
+        pruned = []
+        for key in entries:
+            if key in keep or key in protected:
+                continue
+            for suffix in (".json", ".stablehlo"):
+                try:
+                    os.unlink(os.path.join(self.root, key + suffix))
+                except OSError:
+                    pass
+            pruned.append(key)
+        if pruned:
+            log.info("artifact cache pruned %d stale entr%s (kept %d)",
+                     len(pruned), "y" if len(pruned) == 1 else "ies",
+                     len(entries) - len(pruned))
+        return pruned

@@ -1,0 +1,542 @@
+"""Serving fleet: train-while-serve weight hot-swap + multi-worker dispatch.
+
+ROADMAP item 3's fleet, in three pieces:
+
+- :class:`WeightPublisher` — the TRAINING side.  Writes versioned,
+  fingerprint-keyed weight artifacts into a publish directory: one
+  ``v<NNNNN>.npz`` blob (the flattened param leaves) + one
+  ``v<NNNNN>.json`` manifest (version, content fingerprint, leaf
+  shapes/dtypes, caller metadata) per publish, and an atomically-rewritten
+  ``latest.json`` pointer.  Every write is temp-file + ``os.replace`` so a
+  killed trainer can never leave a torn blob behind a validating pointer.
+  Old versions are pruned (``keep_versions``), and when the publisher is
+  handed the serving tier's :class:`~gsc_tpu.serve.cache.ArtifactCache` it
+  also GCs stale compiled-policy entries (``ArtifactCache.prune``) — the
+  per-version artifact sets hot-swap publishing creates would otherwise
+  grow without bound.
+
+- :class:`VersionWatcher` — the WORKER side.  A daemon thread polls
+  ``latest.json``; when a newer version appears it loads + fingerprint-
+  validates the blob, stages the leaves onto the device, and calls
+  ``PolicyServer.apply_weights`` — which swaps the served params under the
+  batcher's ``flush_lock``, strictly BETWEEN device dispatches.  The swap
+  contract: no batch ever mixes policy versions (the version stamped on a
+  flush is read under the same lock the swap takes), zero requests are
+  dropped or errored across a swap (the queue is untouched; in-flight
+  futures complete under the version that dispatched them), and a corrupt
+  or mismatched artifact is skipped loudly (counter + log) without
+  touching the served weights.
+
+- :class:`FleetDispatcher` — N :class:`~gsc_tpu.serve.server.PolicyServer`
+  workers behind least-queue-depth routing (Podracer-style per-device
+  actors, arXiv 2104.06272), with SLO-burn-driven brownout: when the
+  fleet's error budget burns faster than ``brownout_burn`` and the least
+  loaded worker already has a backlog — or a worker rejects on a full
+  queue — overflow is shed to the SPR fallback tier (TF-Agents'
+  batched-everything bottom tier, arXiv 1709.02878) instead of being
+  rejected.  Every shed request is counted
+  (``serve_brownout_total{reason=slo_burn|overflow}``).
+
+The publisher/watcher protocol is plain files on purpose: the trainer and
+the serving fleet share nothing but a directory (local disk, NFS, a
+GCS-fuse mount), which is exactly the Podracer learner→actor weight path
+minus the RPC dependency.  One writer per directory; any number of
+watchers.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import logging
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batcher import ServeError, ServeFuture
+
+log = logging.getLogger("gsc_tpu.serve.fleet")
+
+# weight-artifact layout version (bump on any blob/manifest change)
+WEIGHTS_FORMAT = 1
+
+_VERSION_RE = re.compile(r"^v(\d{5,})\.json$")
+
+
+def _vname(version: int) -> str:
+    return f"v{version:05d}"
+
+
+def params_fingerprint(leaves: Sequence[np.ndarray]) -> str:
+    """Content identity of a flattened param tree: sha256 over every
+    leaf's shape, dtype and bytes in leaf order — the weight-artifact
+    analogue of ``utils.checkpoint.checkpoint_fingerprint`` (retraining
+    changes it, a republish of identical weights does not)."""
+    h = hashlib.sha256()
+    for leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _leaf_sig(leaves: Sequence[np.ndarray]) -> List[List]:
+    return [[list(np.asarray(l).shape), str(np.asarray(l).dtype)]
+            for l in leaves]
+
+
+class WeightPublisher:
+    """Training-side writer of versioned weight artifacts.
+
+    ``publish(params)`` accepts any pytree (or an already-flat leaf
+    list); the leaves are flattened in ``jax.tree_util`` order, which is
+    the order the watcher rebuilds them in — publisher and worker must
+    agree on the tree structure (they do: both sides hold the same actor
+    params template)."""
+
+    def __init__(self, root: str, keep_versions: int = 8, hub=None,
+                 artifact_cache=None, artifact_keep: int = 8):
+        if keep_versions < 1:
+            raise ValueError(f"keep_versions must be >= 1: {keep_versions}")
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.keep_versions = int(keep_versions)
+        self.hub = hub
+        # the serving tier's compiled-policy cache (optional): pruned
+        # after each publish so per-fingerprint artifact sets don't
+        # accumulate one generation per published version
+        self.artifact_cache = artifact_cache
+        self.artifact_keep = int(artifact_keep)
+        self._version = self._scan_latest_version()
+
+    def _scan_latest_version(self) -> int:
+        latest = 0
+        for path in glob.glob(os.path.join(self.root, "v*.json")):
+            m = _VERSION_RE.match(os.path.basename(path))
+            if m:
+                latest = max(latest, int(m.group(1)))
+        return latest
+
+    @property
+    def version(self) -> int:
+        """The last published version (0 = nothing published yet)."""
+        return self._version
+
+    def publish(self, params, meta: Optional[Dict] = None) -> Dict:
+        """Write the next version; returns the manifest record."""
+        leaves = self._flatten(params)
+        version = self._version + 1
+        name = _vname(version)
+        fingerprint = params_fingerprint(leaves)
+        blob_path = os.path.join(self.root, name + ".npz")
+        # atomic blob: npz to a temp file, then rename into place
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **{f"leaf_{i}": np.asarray(l)
+                               for i, l in enumerate(leaves)})
+            os.replace(tmp, blob_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        record = {
+            "format": WEIGHTS_FORMAT,
+            "version": version,
+            "fingerprint": fingerprint,
+            "blob": os.path.basename(blob_path),
+            "leaves": _leaf_sig(leaves),
+            "ts": round(time.time(), 3),
+            "meta": meta or {},
+        }
+        from ..obs.sinks import write_atomic_json
+        write_atomic_json(os.path.join(self.root, name + ".json"), record)
+        # the pointer goes last: a watcher that reads it can always trust
+        # the blob+manifest it names are complete
+        write_atomic_json(os.path.join(self.root, "latest.json"), record)
+        self._version = version
+        self._prune_versions()
+        if self.artifact_cache is not None:
+            try:
+                self.artifact_cache.prune(keep_latest=self.artifact_keep)
+            except OSError as e:   # GC must never fail a publish
+                log.warning("artifact-cache prune failed: %s", e)
+        if self.hub is not None:
+            self.hub.event("weight_publish", version=version,
+                           fingerprint=fingerprint,
+                           **({"meta": meta} if meta else {}))
+            self.hub.gauge("serve_published_version", version)
+        return record
+
+    @staticmethod
+    def _flatten(params) -> List[np.ndarray]:
+        if isinstance(params, (list, tuple)) and all(
+                isinstance(l, np.ndarray) for l in params):
+            return list(params)
+        import jax
+        leaves = jax.tree_util.tree_flatten(params)[0]
+        return [np.asarray(l) for l in leaves]
+
+    def _prune_versions(self):
+        """Keep the newest ``keep_versions`` (the latest is never
+        touched); a blob whose manifest is already gone — or vice versa
+        (a crashed earlier prune) — still gets collected."""
+        versions = sorted(
+            {int(m.group(1))
+             for p in glob.glob(os.path.join(self.root, "v*.json"))
+             for m in [_VERSION_RE.match(os.path.basename(p))] if m}
+            | {int(m.group(1))
+               for p in glob.glob(os.path.join(self.root, "v*.npz"))
+               for m in [re.match(r"^v(\d{5,})\.npz$",
+                                  os.path.basename(p))] if m},
+            reverse=True)
+        for version in versions[self.keep_versions:]:
+            if version == self._version:
+                continue
+            for suffix in (".json", ".npz"):   # manifest first: a
+                # pointer-less blob is untrusted, the reverse is a
+                # manifest naming a missing blob (load_version rejects
+                # both, but manifest-first never exposes the second)
+                try:
+                    os.unlink(os.path.join(self.root,
+                                           _vname(version) + suffix))
+                except OSError:
+                    pass
+
+
+def read_latest(root: str) -> Optional[Dict]:
+    """The current ``latest.json`` record; None when missing, torn or not
+    describing a weights artifact (all tolerated — the watcher just polls
+    again)."""
+    try:
+        with open(os.path.join(root, "latest.json")) as f:
+            rec = json.load(f)
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(rec, dict) or rec.get("format") != WEIGHTS_FORMAT \
+            or not isinstance(rec.get("version"), int):
+        return None
+    return rec
+
+
+def load_version(root: str, record: Dict) -> List[np.ndarray]:
+    """Load + validate one published version's leaves.  Raises
+    ``ValueError`` when the blob is missing/corrupt, the leaf signature
+    disagrees with the manifest, or the content fingerprint does not
+    match — a watcher must never swap unverified bytes in."""
+    blob_path = os.path.join(root, record["blob"])
+    try:
+        with np.load(blob_path) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    except (OSError, ValueError, KeyError) as e:
+        raise ValueError(f"weights blob unreadable: {blob_path} "
+                         f"({type(e).__name__}: {e})")
+    if _leaf_sig(leaves) != record.get("leaves"):
+        raise ValueError(f"weights blob leaf signature does not match its "
+                         f"manifest: {blob_path}")
+    fp = params_fingerprint(leaves)
+    if fp != record.get("fingerprint"):
+        raise ValueError(f"weights blob fingerprint mismatch: {blob_path} "
+                         f"(manifest {record.get('fingerprint')!r:.20} != "
+                         f"content {fp!r:.20})")
+    return leaves
+
+
+class VersionWatcher:
+    """Worker-side poller: swaps newly published versions into a running
+    :class:`~gsc_tpu.serve.server.PolicyServer` between dispatches."""
+
+    def __init__(self, root: str, server, poll_s: float = 0.2, hub=None,
+                 max_retries: int = 5):
+        self.root = os.path.abspath(root)
+        self.server = server
+        self.poll_s = float(poll_s)
+        self.hub = hub
+        # bounded per-version retry budget: a transient read failure
+        # (NFS/GCS-fuse close-to-open lag can expose the manifest before
+        # the blob settles) must not strand a worker on the old version
+        # forever — but a genuinely corrupt artifact must not be
+        # re-logged every poll either.  After max_retries the version is
+        # parked until a strictly newer one appears.
+        self.max_retries = int(max_retries)
+        self.swaps = 0
+        self._failed_version: Optional[int] = None
+        self._failed_tries = 0
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "VersionWatcher":
+        if self._thread is None:
+            self._stop_event.clear()
+            self._thread = threading.Thread(target=self._run,
+                                            name="gsc-serve-watcher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_event.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+    def _run(self):
+        while not self._stop_event.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:   # a poll crash must not kill the thread
+                log.exception("version watcher poll failed")
+
+    def poll_once(self) -> bool:
+        """One poll; returns True iff a swap happened."""
+        rec = read_latest(self.root)
+        if rec is None or rec["version"] <= self.server.policy_version:
+            return False
+        if rec["version"] == self._failed_version \
+                and self._failed_tries >= self.max_retries:
+            return False   # parked: retried enough, wait for a newer one
+        try:
+            leaves = load_version(self.root, rec)
+            self.server.apply_weights(leaves, rec["version"],
+                                      rec["fingerprint"],
+                                      meta=rec.get("meta"))
+        except (ValueError, OSError) as e:
+            if rec["version"] == self._failed_version:
+                self._failed_tries += 1
+            else:
+                self._failed_version = rec["version"]
+                self._failed_tries = 1
+            log.warning(
+                "hot-swap to version %s skipped (attempt %d/%d): %s",
+                rec.get("version"), self._failed_tries,
+                self.max_retries, e)
+            if self.hub is not None:
+                self.hub.counter("serve_swap_failed_total")
+            return False
+        self._failed_version = None
+        self._failed_tries = 0
+        self.swaps += 1
+        return True
+
+
+class FleetDispatcher:
+    """Least-queue-depth routing over N workers + SLO-burn brownout.
+
+    ``workers`` are started/closed by the dispatcher (so are the
+    brownout tier and each worker's :class:`VersionWatcher` — the
+    server owns its watcher).  ``spr`` is the optional brownout target:
+    a fallback-tier :class:`PolicyServer` that absorbs overflow instead
+    of the fleet rejecting it."""
+
+    def __init__(self, workers: Sequence, spr=None, hub=None,
+                 brownout_burn: Optional[float] = 2.0,
+                 burn_refresh_s: float = 0.25):
+        if not workers:
+            raise ValueError("a fleet needs at least one worker")
+        self.workers = list(workers)
+        self.spr = spr
+        self.hub = hub
+        # error-budget burn rate above which (with a backlog on the least
+        # loaded worker) new load sheds to the SPR tier; None disables
+        # proactive shedding (overflow shedding on queue_full stays on)
+        self.brownout_burn = brownout_burn
+        self.burn_refresh_s = float(burn_refresh_s)
+        self._burn_cache: Tuple[float, Optional[float]] = (0.0, None)
+        self._burn_lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "FleetDispatcher":
+        for w in self.workers:
+            w.start()
+        if self.spr is not None:
+            self.spr.start()
+        if self.hub is not None:
+            self.hub.event(
+                "fleet_start", workers=[w.worker for w in self.workers],
+                tier=self.workers[0].tier,
+                brownout=("spr" if self.spr is not None else None),
+                brownout_burn=self.brownout_burn)
+        return self
+
+    def close(self):
+        for w in self.workers:
+            w.close()
+        if self.spr is not None:
+            self.spr.close()
+        if self.hub is not None:
+            # fleet-level final record AFTER the workers' final
+            # serve_stats: the per-worker events carry worker-local
+            # counts, this one carries the fleet totals obs_report's
+            # fleet view leads with
+            self.hub.event(
+                "fleet_stats", final=True,
+                workers=[w.worker for w in self.workers],
+                requests=self.completed, swaps=self.swap_total(),
+                brownout={reason: int(self.hub.get_counter(
+                    "serve_brownout_total", reason=reason))
+                    for reason in ("slo_burn", "overflow")},
+                per_worker={w.worker: {
+                    "requests": w._completed,
+                    "policy_version": w.policy_version,
+                    "swaps": w.swaps,
+                    "occupancy": {str(b): n for b, n in
+                                  sorted(w._occupancy.items())},
+                } for w in self.workers},
+                slo=self.slo_summary())
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------ requests
+    def submit(self, obs) -> ServeFuture:
+        """Route one request: least queue depth wins; ties go to the
+        first worker (stable under the idle fleet).  Sheds to the SPR
+        tier on sustained budget burn (proactive) or a full worker queue
+        (reactive) — the fleet only rejects when there is nowhere left
+        to put the request."""
+        worker = min(self.workers, key=lambda w: w.queue_depth)
+        if self.spr is not None and self._should_brownout(worker):
+            self._count_brownout("slo_burn")
+            return self.spr.submit(obs)
+        try:
+            return worker.submit(obs)
+        except ServeError:
+            if self.spr is None:
+                raise
+            self._count_brownout("overflow")
+            return self.spr.submit(obs)
+
+    def submit_sync(self, obs, timeout: Optional[float] = 60.0):
+        return self.submit(obs).result(timeout)
+
+    # ------------------------------------------------------------ brownout
+    def _count_brownout(self, reason: str):
+        if self.hub is not None:
+            self.hub.counter("serve_brownout_total", reason=reason)
+
+    def _should_brownout(self, worker) -> bool:
+        if self.brownout_burn is None or worker.queue_depth < 1:
+            return False
+        burn = self._fleet_burn()
+        return burn is not None and burn > self.brownout_burn
+
+    def _fleet_burn(self) -> Optional[float]:
+        """Max error-budget burn rate across the workers' SLO engines,
+        refreshed at ``burn_refresh_s`` cadence (an engine snapshot walks
+        its rolling window — too heavy per submit)."""
+        now = time.monotonic()
+        with self._burn_lock:
+            ts, burn = self._burn_cache
+            if now - ts < self.burn_refresh_s:
+                return burn
+            burns = []
+            for w in self.workers:
+                engine = getattr(w, "slo_engine", None)
+                if engine is None:
+                    continue
+                b = engine.snapshot().get("burn_rate")
+                if b is not None:
+                    burns.append(b)
+            burn = max(burns) if burns else None
+            self._burn_cache = (now, burn)
+            return burn
+
+    # --------------------------------------------------------------- stats
+    @property
+    def completed(self) -> int:
+        total = sum(w._completed for w in self.workers)
+        if self.spr is not None:
+            total += self.spr._completed
+        return total
+
+    def swap_total(self) -> int:
+        return sum(w.swaps for w in self.workers)
+
+    def slo_summary(self) -> Optional[Dict]:
+        doc = self.merged_slo()
+        if doc is None:
+            return None
+        out = {k: doc.get(k) for k in
+               ("requests", "deadline_misses", "deadline_miss_ratio",
+                "attainment", "burn_rate", "pad_waste",
+                "queue_wait_frac", "arrival_rate_rps", "rejected")}
+        out["p99_target_ms"] = (doc.get("objectives") or {}).get("p99_ms")
+        return out
+
+    def merged_slo(self) -> Optional[Dict]:
+        """One fleet-level SLO document from the workers' engines.
+
+        Counts (requests, misses, flushes, rejections) sum exactly;
+        window-derived ratios merge as weighted means (attainment by
+        window size, pad waste by flushes, queue-wait fraction by
+        requests) — a faithful approximation, since the per-worker sums
+        behind them are not exposed.  Burn is recomputed from the merged
+        attainment so the fleet number stays internally consistent."""
+        snaps = [(w, w.slo_engine.snapshot()) for w in self.workers
+                 if getattr(w, "slo_engine", None) is not None]
+        if not snaps:
+            return None
+        first = snaps[0][1]
+        requests = sum(s["requests"] for _, s in snaps)
+        misses = sum(s["deadline_misses"] for _, s in snaps)
+        errored = sum(s["errored_requests"] for _, s in snaps)
+        flushes = sum(s["flushes"] for _, s in snaps)
+        rejected: Dict[str, int] = {}
+        for _, s in snaps:
+            for reason, n in (s.get("rejected") or {}).items():
+                rejected[reason] = rejected.get(reason, 0) + int(n)
+
+        def wmean(key, weight_key):
+            num = den = 0.0
+            for _, s in snaps:
+                v, w = s.get(key), s.get(weight_key)
+                if key == "attainment":
+                    w = (s.get("window") or {}).get("size")
+                if v is None or not w:
+                    continue
+                num += v * w
+                den += w
+            return round(num / den, 6) if den else None
+
+        attainment = wmean("attainment", "window")
+        burn = None
+        if attainment is not None:
+            budget = 1.0 - first["objectives"]["target_attainment"]
+            burn = round((1.0 - attainment) / budget, 4)
+        rates = [s.get("arrival_rate_rps") for _, s in snaps
+                 if s.get("arrival_rate_rps") is not None]
+        return {
+            "fleet_workers": [w.worker for w, _ in snaps],
+            "deadline_ms": first["deadline_ms"],
+            "objectives": first["objectives"],
+            "requests": requests,
+            "errored_requests": errored,
+            "deadline_misses": misses,
+            "deadline_miss_ratio": (round(misses / requests, 6)
+                                    if requests else None),
+            "attainment": attainment,
+            "burn_rate": burn,
+            "arrival_rate_rps": (round(sum(rates), 3) if rates else None),
+            "flushes": flushes,
+            "pad_waste": wmean("pad_waste", "flushes"),
+            "queue_wait_frac": wmean("queue_wait_frac", "requests"),
+            "rejected": rejected,
+            "per_worker": {w.worker: {
+                "requests": s["requests"],
+                "deadline_miss_ratio": s["deadline_miss_ratio"],
+                "attainment": s["attainment"],
+                "pad_waste": s["pad_waste"],
+            } for w, s in snaps},
+        }

@@ -82,7 +82,10 @@ class PolicyServer:
                  graph_mode: bool = True,
                  hub=None, stats_interval: int = 50,
                  max_queue: int = 4096, perf=None,
-                 tracer=None, slo=None, slo_path: Optional[str] = None):
+                 tracer=None, slo=None, slo_path: Optional[str] = None,
+                 mode: str = "deadline", worker: Optional[str] = None,
+                 hot_swap_dir: Optional[str] = None,
+                 swap_poll_s: float = 0.2):
         if (policy is None) == (fallback is None):
             raise ValueError("exactly one of policy (learned tier, with "
                              "params) or fallback (SPR tier) is required")
@@ -118,6 +121,23 @@ class PolicyServer:
         self.slo_engine = None
         self.stats_interval = max(int(stats_interval), 1)
         self.max_queue = max_queue
+        # batching discipline (serve.batcher.BATCH_MODES): "deadline" is
+        # the historic flush-cycle batcher, "continuous" forms the next
+        # batch while the current device call is in flight
+        self.mode = mode
+        # fleet worker id: tags the queue-depth gauge + per-worker
+        # counters and stamps serve_start/serve_stats/weight_swap events
+        # (None = the historic single-server series, untouched)
+        self.worker = worker
+        self._wtag = {"worker": worker} if worker else {}
+        # live weight hot-swap: watch this publish directory
+        # (serve.fleet.WeightPublisher layout) and swap new versions in
+        # between dispatches; policy_version stamps every flush
+        self.hot_swap_dir = hot_swap_dir
+        self.swap_poll_s = swap_poll_s
+        self.watcher = None
+        self.policy_version = 0
+        self.swaps = 0
         self.batcher: Optional[MicroBatcher] = None
         self.startup: Dict = {}
         self._exec: Dict[int, object] = {}
@@ -141,21 +161,29 @@ class PolicyServer:
         if self.tracer is not None:
             from ..obs.slo import SLOEngine
             self.slo_engine = SLOEngine(deadline_ms=self.deadline_ms,
-                                        objectives=self.slo, hub=self.hub)
+                                        objectives=self.slo, hub=self.hub,
+                                        tags=self._wtag)
             self.tracer.bind_engine(self.slo_engine)
             self.tracer.start()
         self.batcher = MicroBatcher(
             run_batch, template, buckets=self.buckets,
             deadline_ms=self.deadline_ms, hub=self.hub,
             max_queue=self.max_queue, on_flush=self._on_flush,
-            tracer=self.tracer).start()
+            tracer=self.tracer, mode=self.mode, worker=self.worker,
+            version_provider=lambda: self.policy_version).start()
         if self.hub is not None and hasattr(self.hub, "live_gauge"):
             # the /metrics endpoint snapshots the hub on every scrape —
             # a live probe keeps serve_queue_depth current mid-run
-            # instead of frozen at the last flush/submit sample
+            # instead of frozen at the last flush/submit sample (tagged
+            # per worker in a fleet so N probes never collide)
             batcher = self.batcher
             self.hub.live_gauge("serve_queue_depth",
-                                lambda: batcher.queue_depth)
+                                lambda: batcher.queue_depth, **self._wtag)
+        if self.hot_swap_dir is not None:
+            from .fleet import VersionWatcher
+            self.watcher = VersionWatcher(self.hot_swap_dir, self,
+                                          poll_s=self.swap_poll_s,
+                                          hub=self.hub).start()
         self._t_started = time.perf_counter()
         self.startup = {
             "tier": self.tier,
@@ -167,10 +195,14 @@ class PolicyServer:
             self.hub.event("serve_start", tier=self.tier,
                            buckets=list(self.buckets),
                            deadline_ms=self.deadline_ms,
+                           mode=self.mode,
                            startup_s=self.startup["startup_s"],
                            bucket_prepare=per_bucket,
                            cache_dir=self.startup["cache_dir"],
-                           fingerprint=self.fingerprint)
+                           fingerprint=self.fingerprint,
+                           **({"worker": self.worker, "hot_swap_dir":
+                               self.hot_swap_dir} if self.worker
+                              or self.hot_swap_dir else {}))
         return self
 
     def _prepare_bucket(self, b: int) -> Dict:
@@ -226,12 +258,17 @@ class PolicyServer:
         jax.block_until_ready(self._exec[b](self.params, *zeros))
 
     def close(self):
+        if self.watcher is not None:
+            # stop watching BEFORE the drain: a swap landing mid-teardown
+            # has nothing left to serve anyway
+            self.watcher.stop()
+            self.watcher = None
         if self.batcher is not None:
             self.batcher.stop()
             self.batcher = None
         if self.hub is not None and hasattr(self.hub, "drop_live_gauge"):
-            self.hub.drop_live_gauge("serve_queue_depth")
-            self.hub.gauge("serve_queue_depth", 0)
+            self.hub.drop_live_gauge("serve_queue_depth", **self._wtag)
+            self.hub.gauge("serve_queue_depth", 0, **self._wtag)
         if self.tracer is not None:
             # final drain BEFORE the final stats event, so the last
             # flushes' spans and SLO updates are in the summary
@@ -273,6 +310,90 @@ class PolicyServer:
 
     def submit_sync(self, obs, timeout: Optional[float] = 60.0):
         return self.submit(obs).result(timeout)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.batcher.queue_depth if self.batcher is not None else 0
+
+    # ------------------------------------------------------------ hot-swap
+    def apply_weights(self, leaves, version: int, fingerprint: str,
+                      meta: Optional[Dict] = None):
+        """Swap a published weight version in, strictly between device
+        dispatches.
+
+        Learned tier: ``leaves`` (host arrays in ``jax.tree_util``
+        flatten order) must match the served params' leaf shapes/dtypes
+        exactly — the AOT-compiled buckets were lowered for that
+        signature, so a mismatch raises and the served weights stay
+        untouched.  Device staging (``jnp.asarray``) happens BEFORE the
+        flush lock is taken; the lock is held only for the reference
+        swap, so a swap stalls serving by nanoseconds, not a transfer.
+
+        SPR tier: the heuristic has no network weights — a published
+        single-leaf artifact matching the precomputed action's
+        shape/dtype swaps the action itself (recomputed topology), any
+        other payload bumps the version stamp only.  Either way the full
+        version/locking/event machinery runs, which is what a fallback-
+        tier fleet exercises in CI.
+
+        Zero requests are dropped or errored by a swap: the queue is
+        never touched, and each dispatch stamps the version it actually
+        ran under (the flush lock makes that exact)."""
+        t0 = time.perf_counter()
+        staged_params = staged_action = None
+        if self.tier == "learned":
+            import jax
+            import jax.numpy as jnp
+
+            cur_leaves, treedef = jax.tree_util.tree_flatten(self.params)
+            if len(leaves) != len(cur_leaves):
+                raise ValueError(
+                    f"hot-swap version {version} has {len(leaves)} leaves, "
+                    f"served params have {len(cur_leaves)}")
+            for i, (new, cur) in enumerate(zip(leaves, cur_leaves)):
+                new = np.asarray(new)
+                if (tuple(new.shape) != tuple(jnp.shape(cur))
+                        or str(new.dtype) != str(jnp.asarray(cur).dtype)):
+                    raise ValueError(
+                        f"hot-swap version {version} leaf {i} is "
+                        f"{new.shape}/{new.dtype}, served params want "
+                        f"{tuple(jnp.shape(cur))}/"
+                        f"{jnp.asarray(cur).dtype} — the compiled "
+                        "buckets cannot run it")
+            staged_params = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(l) for l in leaves])
+        else:
+            action = self.fallback.action
+            if len(leaves) == 1 and tuple(np.asarray(leaves[0]).shape) \
+                    == tuple(action.shape):
+                staged_action = np.asarray(leaves[0]).astype(action.dtype)
+        lock = self.batcher.flush_lock if self.batcher is not None else None
+        if lock is not None:
+            lock.acquire()
+        try:
+            if staged_params is not None:
+                self.params = staged_params
+            if staged_action is not None:
+                self.fallback.action = staged_action
+            self.policy_version = int(version)
+            self.fingerprint = fingerprint
+        finally:
+            if lock is not None:
+                lock.release()
+        self.swaps += 1
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        if self.hub is not None:
+            self.hub.counter("serve_weight_swaps_total", **self._wtag)
+            self.hub.gauge("serve_policy_version", version, **self._wtag)
+            self.hub.event(
+                "weight_swap", version=int(version),
+                fingerprint=fingerprint, tier=self.tier,
+                swap_ms=round(swap_ms, 3),
+                weights_applied=bool(staged_params is not None
+                                     or staged_action is not None),
+                requests_in_flight=self.queue_depth,
+                **({"worker": self.worker} if self.worker else {}),
+                **({"meta": meta} if meta else {}))
 
     # ------------------------------------------------------------ internals
     def _run_learned(self, leaves, n_real: int, bucket: int) -> np.ndarray:
@@ -388,6 +509,15 @@ class PolicyServer:
                      "pad_waste", "queue_wait_frac")}
                 extra["slo"]["p99_target_ms"] = \
                     (snap.get("objectives") or {}).get("p99_ms")
+        if self.worker:
+            # fleet context: per-worker request/batch counters + the
+            # worker's own completion count (the untagged histograms are
+            # fleet aggregates, so `requests` below is fleet-wide)
+            extra["worker"] = self.worker
+            extra["worker_requests"] = self._completed
+        if self.policy_version or self.swaps:
+            extra["policy_version"] = self.policy_version
+            extra["swaps"] = self.swaps
         self.hub.event(
             "serve_stats", tier=self.tier, final=final,
             requests=self._completed,
@@ -396,7 +526,8 @@ class PolicyServer:
             p99_ms=round(lat.get("p99", 0.0), 3),
             mean_ms=round(lat.get("mean", 0.0), 3),
             max_ms=round(lat.get("max", 0.0), 3),
-            queue_depth=int(self.hub.get_gauge("serve_queue_depth") or 0),
+            queue_depth=int(self.hub.get_gauge("serve_queue_depth",
+                                               **self._wtag) or 0),
             occupancy={str(b): n for b, n in
                        sorted(self._occupancy.items())},
             buckets=per_bucket, **extra)

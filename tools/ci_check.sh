@@ -92,6 +92,15 @@ echo "== serveobs smoke (request tracing + SLO engine -> slo.json + trace + gate
 # tools/serveobs_smoke.py asserts all of it
 env JAX_PLATFORMS=cpu python tools/serveobs_smoke.py
 
+echo "== fleet smoke (continuous batching + hot-swap under load, 2 workers) =="
+# a 2-worker SPR-tier real-CLI run with --continuous and one forced
+# hot-swap must rc=0 with ZERO dropped requests, policy_version on every
+# serve_flush event, per-worker queue gauges in the /metrics exposition,
+# weight_swap events from both workers, and the fleet-merged slo.json
+# gating through bench_diff (self-compare rc 0, injected p99 regression
+# rc 1) — tools/fleet_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -1,0 +1,158 @@
+"""Fleet smoke: continuous batching + live hot-swap through the real CLI.
+
+The CI-stage proof that the serving fleet executes end to end: a 2-worker
+SPR-tier run (no checkpoint — the fallback tier shares the whole
+batcher/dispatcher/watcher path without paying an AOT compile) with
+``--continuous`` and ONE forced hot-swap fired under load must
+
+- exit 0 with ZERO dropped/errored requests and every published version
+  swapped into every worker (`swaps == workers * published_versions`),
+- leave ``weight_swap`` events (one per worker) and ``serve_flush``
+  events that ALL carry the ``policy_version`` field, in ``events.jsonl``,
+- expose per-worker queue-depth gauges and per-worker request counters in
+  the /metrics exposition (``metrics.json`` is the same snapshot the live
+  endpoint serves) — the PR 12 gauges must not collide across workers,
+- write the fleet-merged ``slo.json`` and gate through ``bench_diff``:
+  self-compare rc 0, an injected p99 regression rc 1.
+
+Run by ``tools/ci_check.sh`` after the serveobs stage; standalone:
+
+    JAX_PLATFORMS=cpu python tools/fleet_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+# runnable from any cwd: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REQUESTS = 48
+WORKERS = 2
+
+
+def fail(msg: str) -> int:
+    print(f"fleet smoke: FAIL — {msg}")
+    return 1
+
+
+def main() -> int:
+    from chaos_smoke import _configure_jax, write_tiny_configs
+    _configure_jax()
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+
+    tmp = tempfile.mkdtemp(prefix="gsc_fleet_")
+    args = write_tiny_configs(os.path.join(tmp, "cfg"))
+    configs = args[:4]
+    extra = [a for a in args[4:] if a != "--quiet"]
+    r = CliRunner().invoke(cli, [
+        "serve", *configs, *extra,          # no checkpoint: SPR tier
+        "--requests", str(REQUESTS), "--concurrency", "6",
+        "--buckets", "1,4", "--deadline-ms", "2", "--pool-steps", "2",
+        "--continuous", "--workers", str(WORKERS),
+        "--hot-swap-dir", os.path.join(tmp, "weights"),
+        "--swap-poll-s", "0.02", "--fire-swaps", "1",
+        "--trace-sample", "1", "--slo-p99-ms", "100",
+        "--result-dir", os.path.join(tmp, "res")])
+    if r.exit_code != 0:
+        print(r.output)
+        if r.exception is not None:
+            import traceback
+            traceback.print_exception(type(r.exception), r.exception,
+                                      r.exception.__traceback__)
+        return fail(f"serve rc={r.exit_code}")
+    out = json.loads(r.output.strip().splitlines()[-1])
+    rdir = out["result_dir"]
+
+    # zero dropped/errored requests across the swap — the hot-swap
+    # contract, and the reason the fleet exists
+    if out["errors"]:
+        return fail(f"{out['errors']} request(s) dropped/errored across "
+                    f"the hot-swap: {out['error_detail']}")
+    if out["workers"] != WORKERS or out["mode"] != "continuous":
+        return fail(f"fleet shape wrong: {out['workers']} workers, "
+                    f"mode {out['mode']}")
+    if out["published_versions"] != 1:
+        return fail(f"--fire-swaps 1 published "
+                    f"{out['published_versions']} versions")
+    if out["swaps"] != WORKERS:
+        return fail(f"expected every worker to swap once: swaps="
+                    f"{out['swaps']} != {WORKERS}")
+    if out["policy_version"] != 1:
+        return fail(f"worker policy_version {out['policy_version']} != 1")
+
+    events = [json.loads(line)
+              for line in open(os.path.join(rdir, "events.jsonl"))]
+    flushes = [e for e in events if e["event"] == "serve_flush"]
+    swaps = [e for e in events if e["event"] == "weight_swap"]
+    if not flushes:
+        return fail("no serve_flush events recorded")
+    missing = [e for e in flushes if "policy_version" not in e]
+    if missing:
+        return fail(f"{len(missing)}/{len(flushes)} serve_flush events "
+                    "missing policy_version")
+    if sorted(e.get("worker") for e in swaps) != ["w0", "w1"]:
+        return fail(f"weight_swap events wrong: "
+                    f"{[(e.get('worker'), e.get('version')) for e in swaps]}")
+    if not all(e.get("weights_applied") for e in swaps):
+        return fail("SPR action republish should apply as real weights")
+    workers_seen = {e.get("worker") for e in flushes}
+    if not {"w0", "w1"} <= workers_seen:
+        return fail(f"flushes from only {workers_seen} — least-queue-"
+                    "depth routing never spread the load")
+
+    # per-worker gauges/counters in the /metrics exposition (metrics.json
+    # is the same hub snapshot the live endpoint serves)
+    mj = json.load(open(os.path.join(rdir, "metrics.json")))["metrics"]
+    for w in ("w0", "w1"):
+        if not any("serve_queue_depth" in k and f'worker="{w}"' in k
+                   for k in mj):
+            return fail(f"no worker-tagged queue-depth gauge for {w}")
+        if not any("serve_requests_total" in k and f'worker="{w}"' in k
+                   for k in mj):
+            return fail(f"no worker-tagged request counter for {w}")
+
+    # fleet-merged slo.json gates through bench_diff
+    slo_path = os.path.join(rdir, "slo.json")
+    if not os.path.exists(slo_path):
+        return fail("fleet slo.json not written")
+    doc = json.load(open(slo_path))
+    if doc.get("schema_version") != 1 or doc.get("requests") != REQUESTS:
+        return fail(f"fleet slo.json incomplete: schema="
+                    f"{doc.get('schema_version')} requests="
+                    f"{doc.get('requests')}")
+    if sorted(doc.get("fleet_workers") or []) != ["w0", "w1"]:
+        return fail(f"slo.json fleet_workers {doc.get('fleet_workers')}")
+    import bench_diff
+    traj = os.path.join(tmp, "traj.json")
+    doc2 = bench_diff.ingest([slo_path], traj)
+    (row_name,) = [n for n in doc2["rows"] if n.startswith("slo_")]
+    rc = bench_diff.main(["diff", row_name, "--baseline", row_name,
+                          "--trajectory", traj])
+    if rc != 0:
+        return fail(f"slo self-compare rc={rc} (want 0)")
+    bad = dict(doc)
+    bad["p99_latency_ms"] = (doc["p99_latency_ms"] or 1.0) * 2.0 + 1.0
+    bad_path = os.path.join(tmp, "bad_slo.json")
+    with open(bad_path, "w") as f:
+        json.dump(bad, f)
+    rc = bench_diff.main(["diff", bad_path, "--baseline", row_name,
+                          "--trajectory", traj])
+    if rc != 1:
+        return fail(f"injected p99 regression rc={rc} (want 1)")
+
+    print(f"fleet smoke: OK — {REQUESTS} requests over {WORKERS} "
+          f"continuous workers with {out['swaps']} hot-swap(s) under "
+          f"load, zero drops, policy_version on all {len(flushes)} "
+          "flushes, per-worker gauges exposed, fleet slo.json gated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -377,7 +377,45 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     serve_stats = [e for e in events if e.get("event") == "serve_stats"]
     serving = None
     if serve_start is not None or serve_stats:
-        last = serve_stats[-1] if serve_stats else {}
+        # headline numbers come from the last NON-worker stats record
+        # when one exists (single-server runs); in a fleet every
+        # serve_stats is worker-tagged, so the shared-histogram numbers
+        # (p50/p99/rps) are fleet-wide on any of them while the request
+        # total comes from fleet_stats below
+        untagged = [e for e in serve_stats if not e.get("worker")]
+        if untagged:
+            last = untagged[-1]
+        elif serve_stats:
+            # fleet run: prefer a real worker's record over the spr
+            # brownout tier's (it closes last, and its tier/SLO would
+            # mislabel a learned fleet's headline)
+            non_spr = [e for e in serve_stats if e.get("worker") != "spr"]
+            last = (non_spr or serve_stats)[-1]
+        else:
+            last = {}
+        # fleet view (cli serve --workers N): per-worker final stats
+        # (each worker's serve_stats carry worker= + worker-local
+        # requests/occupancy), the fleet_stats total record, and the
+        # hot-swap timeline from weight_swap events
+        per_worker: Dict[str, Dict] = {}
+        for ev in serve_stats:
+            if ev.get("worker"):
+                per_worker[ev["worker"]] = {
+                    "requests": ev.get("worker_requests",
+                                       ev.get("requests")),
+                    "occupancy": ev.get("occupancy") or {},
+                    "queue_depth": ev.get("queue_depth"),
+                    "policy_version": ev.get("policy_version", 0),
+                    "swaps": ev.get("swaps", 0),
+                }
+        fleet_stats = next((e for e in reversed(events)
+                            if e.get("event") == "fleet_stats"), None)
+        swap_timeline = [
+            {"worker": ev.get("worker"), "version": ev.get("version"),
+             "ts": ev.get("ts"), "swap_ms": ev.get("swap_ms"),
+             "requests_in_flight": ev.get("requests_in_flight"),
+             "weights_applied": ev.get("weights_applied")}
+            for ev in events if ev.get("event") == "weight_swap"]
         serving = {
             "tier": last.get("tier") or (serve_start or {}).get("tier"),
             "requests": last.get("requests"),
@@ -396,7 +434,24 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
             "decomposition": last.get("decomposition") or {},
             "slo": last.get("slo"),
             "rejected": last.get("rejected") or {},
+            "workers": per_worker,
+            "fleet": fleet_stats,
+            "swap_timeline": swap_timeline,
         }
+        if fleet_stats is not None and not untagged:
+            # fleet run: the request total, merged SLO verdict and
+            # merged occupancy are the fleet's, not the last-reporting
+            # worker's
+            serving["requests"] = fleet_stats.get("requests",
+                                                  serving["requests"])
+            if fleet_stats.get("slo"):
+                serving["slo"] = fleet_stats["slo"]
+            merged_occ: Dict[str, int] = {}
+            for rec in per_worker.values():
+                for b, n in (rec.get("occupancy") or {}).items():
+                    merged_occ[b] = merged_occ.get(b, 0) + int(n)
+            if merged_occ:
+                serving["occupancy"] = merged_occ
     return {
         "episodes": len(episodes),
         "run": (episodes[0].get("run") if episodes
@@ -570,6 +625,41 @@ def render_text(summary: Dict, out=sys.stdout):
                   f"{_fmt(row.get('batch_ms'), 10)} "
                   f"{_fmt(row.get('device_ms'), 10)} "
                   f"{_fmt(row.get('fanout_ms'), 10)}\n")
+        if sv.get("workers"):
+            fl = sv.get("fleet") or {}
+            head = f"\n  fleet: {len(sv['workers'])} worker(s)"
+            if fl:
+                head += (f"  {fl.get('requests')} requests total  "
+                         f"{fl.get('swaps')} hot-swap(s)")
+                brown = fl.get("brownout") or {}
+                if any(brown.values()):
+                    head += "  brownout: " + "  ".join(
+                        f"{reason} {n}"
+                        for reason, n in sorted(brown.items()) if n)
+            w(head + "\n")
+            w(f"  {'worker':>8} {'requests':>9} {'queue':>6} "
+              f"{'version':>8} {'swaps':>6} {'occupancy':<24}\n")
+            for name in sorted(sv["workers"]):
+                rec = sv["workers"][name]
+                occ = " ".join(f"b{b}:{n}" for b, n in
+                               sorted((rec.get("occupancy") or {}).items(),
+                                      key=lambda kv: int(kv[0])))
+                w(f"  {name:>8} {_fmt(rec.get('requests'), 9)} "
+                  f"{_fmt(rec.get('queue_depth'), 6)} "
+                  f"{_fmt(rec.get('policy_version'), 8)} "
+                  f"{_fmt(rec.get('swaps'), 6)} {occ:<24}\n")
+        if sv.get("swap_timeline"):
+            w("  hot-swap timeline (version @ wall, requests in flight "
+              "at the swap):\n")
+            t00 = sv["swap_timeline"][0].get("ts") or 0.0
+            for s in sv["swap_timeline"]:
+                dt = (s.get("ts") or 0.0) - t00
+                w(f"    +{dt:7.3f}s  v{s.get('version')}"
+                  f"  worker {s.get('worker') or '-':<5}"
+                  f"  in-flight {_fmt(s.get('requests_in_flight'), 3)}"
+                  f"  swap {_fmt(s.get('swap_ms'), 1)} ms"
+                  + ("" if s.get("weights_applied", True)
+                     else "  (version stamp only)") + "\n")
     rows = summary["rows"]
     if rows:
         w("(*_ms columns are phase-wall deltas between consecutive "
@@ -865,6 +955,35 @@ def _synthetic_events(path: str, episodes: int = 5):
                       "deadline_misses": 24, "arrival_rate_rps": 812.0,
                       "pad_waste": 0.31, "queue_wait_frac": 0.22},
               "rejected": {"queue_full": 3, "stopping": 0}})
+        # fleet view (cli serve --workers N + --hot-swap-dir): per-worker
+        # final serve_stats, the hot-swap timeline, and the fleet total
+        # record — the report renders the worker table + swap timeline
+        emit({"event": "weight_swap", "ts": base + 5.4, "run": "selftest",
+              "worker": "w0", "version": 2, "fingerprint": "def",
+              "tier": "learned", "swap_ms": 0.8, "weights_applied": True,
+              "requests_in_flight": 3})
+        emit({"event": "weight_swap", "ts": base + 5.6, "run": "selftest",
+              "worker": "w1", "version": 2, "fingerprint": "def",
+              "tier": "learned", "swap_ms": 0.5, "weights_applied": True,
+              "requests_in_flight": 1})
+        emit({"event": "serve_stats", "ts": base + 6.1, "run": "selftest",
+              "tier": "learned", "final": True, "requests": 120,
+              "worker": "w0", "worker_requests": 120,
+              "policy_version": 2, "swaps": 1,
+              "rps": 512.5, "p50_ms": 1.2, "p99_ms": 7.9, "mean_ms": 1.9,
+              "max_ms": 9.0, "queue_depth": 1,
+              "occupancy": {"1": 20, "4": 100}, "buckets": {}})
+        emit({"event": "serve_stats", "ts": base + 6.2, "run": "selftest",
+              "tier": "learned", "final": True, "requests": 80,
+              "worker": "w1", "worker_requests": 80,
+              "policy_version": 2, "swaps": 1,
+              "rps": 512.5, "p50_ms": 1.2, "p99_ms": 7.9, "mean_ms": 1.9,
+              "max_ms": 9.0, "queue_depth": 0,
+              "occupancy": {"1": 20, "4": 60}, "buckets": {}})
+        emit({"event": "fleet_stats", "ts": base + 6.3, "run": "selftest",
+              "final": True, "workers": ["w0", "w1"], "requests": 200,
+              "swaps": 2, "brownout": {"slo_burn": 0, "overflow": 5},
+              "per_worker": {}, "slo": None})
         emit({"event": "run_end", "ts": base + episodes + 1,
               "run": "selftest", "status": "ok", "episodes": episodes})
 
@@ -1030,6 +1149,23 @@ def selftest() -> int:
         assert sv["decomposition"]["4"]["batch_ms"] == 2.1 \
             and sv["decomposition"]["1"]["device_ms"] == 0.8, \
             "latency decomposition lost"
+        # fleet view: per-worker table rows + the hot-swap timeline
+        assert set(sv["workers"]) == {"w0", "w1"}, sv["workers"]
+        assert sv["workers"]["w0"] == {
+            "requests": 120, "occupancy": {"1": 20, "4": 100},
+            "queue_depth": 1, "policy_version": 2, "swaps": 1}, \
+            sv["workers"]
+        assert sv["fleet"]["requests"] == 200 \
+            and sv["fleet"]["swaps"] == 2, sv["fleet"]
+        assert [s["version"] for s in sv["swap_timeline"]] == [2, 2] \
+            and sv["swap_timeline"][0]["requests_in_flight"] == 3, \
+            "hot-swap timeline lost"
+        fleet_txt = io.StringIO()
+        render_text(summary, out=fleet_txt)
+        assert "fleet: 2 worker(s)" in fleet_txt.getvalue() \
+            and "hot-swap timeline" in fleet_txt.getvalue() \
+            and "brownout: overflow 5" in fleet_txt.getvalue(), \
+            "fleet table / swap timeline not rendered"
         assert summary["drop_totals"]["TTL"] == 0 + 1 + 2 + 3 + 4
         deltas = phase_deltas([e for e in last_run(load_events(path))
                                if e.get("event") == "episode"])

@@ -9,7 +9,15 @@ subprocess so the startup numbers mean what they claim:
 - **warm leg** (same artifact cache, concurrency = largest bucket):
   ``cache_hit_start_s`` = deserialize + warm only — the number that must
   be seconds, not minutes; every bucket must report a cache hit or the
-  bench fails; the concurrent closed-loop load fills the large bucket.
+  bench fails; the concurrent closed-loop load fills the large bucket;
+- **sustained trio** (unless ``--no-sustained``): one closed-loop load
+  shape (concurrency >= 8, largest bucket > concurrency so the deadline
+  batcher pays its wait every flush) through the deadline batcher, then
+  continuous batching, then continuous batching with ``--swaps`` live
+  weight hot-swaps fired mid-load.  Bank-time gates: zero errors on
+  every leg, all fired swaps completed, continuous rps >= deadline rps,
+  and the swap leg inside the bench_diff p99/slo_* bands vs the no-swap
+  control — SERVE_r02's acceptance criteria, enforced by the tool.
 
 Output artifact (``--out``, default SERVE_r01.json): requests/s and
 p50/p99 per leg and per batch bucket, the two startup walls, each leg's
@@ -69,12 +77,13 @@ def _train_tiny(tmp: str):
 
 def _serve_leg(configs, ckpt, extra, *, requests, concurrency, buckets,
                deadline_ms, cache_dir, result_dir, slo_p99_ms=None,
-               timeout_s=900):
+               timeout_s=900, flags=()):
     cmd = [sys.executable, "-m", "gsc_tpu.cli", "serve", *configs, ckpt,
            *extra, "--requests", str(requests),
            "--concurrency", str(concurrency), "--buckets", buckets,
            "--deadline-ms", str(deadline_ms),
-           "--artifact-cache", cache_dir, "--result-dir", result_dir]
+           "--artifact-cache", cache_dir, "--result-dir", result_dir,
+           *flags]
     if slo_p99_ms is not None:
         cmd += ["--slo-p99-ms", str(slo_p99_ms)]
     t0 = time.perf_counter()
@@ -114,6 +123,25 @@ def main(argv=None) -> int:
                     help="existing checkpoint to serve (with --configs)")
     ap.add_argument("--scenario", default=None,
                     help="scenario label recorded in the artifact")
+    ap.add_argument("--no-sustained", action="store_true",
+                    help="skip the sustained-load trio (deadline "
+                         "reference, continuous control, continuous + "
+                         "hot-swaps under fire) and bank only the "
+                         "historic cold/warm legs")
+    ap.add_argument("--sustained-requests", type=int, default=240,
+                    help="requests per sustained leg [default 240]")
+    ap.add_argument("--sustained-concurrency", type=int, default=8,
+                    help="closed-loop clients per sustained leg — the "
+                         "acceptance floor is 8 [default 8]")
+    ap.add_argument("--sustained-buckets", default="1,8,16",
+                    help="buckets for the sustained legs: the largest "
+                         "deliberately exceeds the concurrency, so the "
+                         "deadline batcher pays its full wait per flush "
+                         "while continuous mode never does — the regime "
+                         "continuous batching exists for [default 1,8,16]")
+    ap.add_argument("--swaps", type=int, default=3,
+                    help="hot-swaps fired during the swap leg "
+                         "(acceptance floor: 3) [default 3]")
     args = ap.parse_args(argv)
 
     import jax
@@ -158,6 +186,88 @@ def main(argv=None) -> int:
         raise SystemExit("cold leg unexpectedly hit a pre-existing cache "
                          f"— stale --artifact-cache dir? {cache_dir}")
 
+    # sustained trio (the hot-swap-under-fire acceptance legs): the same
+    # closed-loop load through (a) the deadline batcher, (b) continuous
+    # batching, (c) continuous batching with --swaps live weight swaps
+    # fired mid-load.  Every leg must answer with zero errors; the swap
+    # leg must stay inside the bench_diff p99/slo_* bands vs the no-swap
+    # control, and continuous throughput must meet the deadline
+    # batcher's — the fleet claims, machine-checked at bank time.
+    sustained = None
+    if not args.no_sustained:
+        sus = dict(requests=args.sustained_requests,
+                   concurrency=args.sustained_concurrency,
+                   buckets=args.sustained_buckets,
+                   deadline_ms=args.deadline_ms, cache_dir=cache_dir,
+                   slo_p99_ms=args.slo_p99_ms)
+        legs["sustained_deadline"] = _serve_leg(
+            configs, ckpt, extra,
+            result_dir=os.path.join(tmp, "serve_sus_deadline"), **sus)
+        legs["sustained_control"] = _serve_leg(
+            configs, ckpt, extra, flags=["--continuous"],
+            result_dir=os.path.join(tmp, "serve_sus_control"), **sus)
+        swap_dir = os.path.join(tmp, "hot_swap")
+        legs["sustained_swap"] = _serve_leg(
+            configs, ckpt, extra,
+            flags=["--continuous", "--hot-swap-dir", swap_dir,
+                   "--swap-poll-s", "0.02",
+                   "--fire-swaps", str(args.swaps)],
+            result_dir=os.path.join(tmp, "serve_sus_swap"), **sus)
+
+        swap_leg = legs["sustained_swap"]
+        if swap_leg["swaps"] < args.swaps:
+            raise SystemExit(
+                f"swap leg completed {swap_leg['swaps']} swaps < "
+                f"{args.swaps} fired — hot-swap-under-fire not proven")
+        dl_rps = legs["sustained_deadline"]["rps"]
+        for name in ("sustained_control", "sustained_swap"):
+            if legs[name]["rps"] < dl_rps:
+                raise SystemExit(
+                    f"continuous leg {name} rps {legs[name]['rps']} < "
+                    f"deadline batcher {dl_rps} — continuous batching "
+                    "must not cost throughput")
+
+        # swap-vs-control through the real bench_diff bands: p99 plus
+        # every slo_* axis — the acceptance gate, applied at bank time
+        # so a red artifact can never be committed green.  p50/rps stay
+        # recorded context rather than gates on this comparison: on a
+        # single-core host the publisher + watcher threads legitimately
+        # steal cycles from the serve path (the throughput floor is
+        # enforced separately against the deadline batcher above)
+        import bench_diff
+
+        def _row(name, leg):
+            metrics = {"p99_ms": leg["p99_ms"]}
+            for k in ("deadline_miss_ratio", "pad_waste",
+                      "queue_wait_frac", "burn_rate", "attainment"):
+                v = (leg.get("slo") or {}).get(k)
+                if isinstance(v, (int, float)):
+                    metrics[f"slo_{k}"] = float(v)
+            return {"name": name, "status": "ok", "metrics": metrics}
+
+        verdict = bench_diff.diff_rows(
+            _row("sustained_swap", legs["sustained_swap"]),
+            _row("sustained_control", legs["sustained_control"]))
+        if verdict["verdict"] == "regression":
+            raise SystemExit(
+                "hot-swap leg regressed out of the bench_diff bands vs "
+                f"the no-swap control: {verdict['regressions']}")
+        sustained = {
+            "concurrency": args.sustained_concurrency,
+            "buckets": [int(b)
+                        for b in args.sustained_buckets.split(",")],
+            "requests_per_leg": args.sustained_requests,
+            "swaps_fired": args.swaps,
+            "swaps_completed": swap_leg["swaps"],
+            "published_versions": swap_leg["published_versions"],
+            "continuous_vs_deadline_rps": round(
+                legs["sustained_control"]["rps"] / dl_rps, 3),
+            "swap_vs_control": {
+                "verdict": verdict["verdict"],
+                "gated_metrics": verdict["gated_metrics"],
+                "regressions": verdict["regressions"]},
+        }
+
     bucket_stats = {}
     for leg in legs.values():
         for b, rec in leg["buckets"].items():
@@ -184,9 +294,14 @@ def main(argv=None) -> int:
         "slo_p99_ms": args.slo_p99_ms,
         "cold_start_s": legs["cold"]["startup"]["startup_s"],
         "cache_hit_start_s": legs["warm"]["startup"]["startup_s"],
+        "sustained": sustained,
         "legs": {
-            name: {"concurrency": 1 if name == "cold"
-                   else max(bucket_list),
+            name: {"concurrency": (
+                       1 if name == "cold"
+                       else args.sustained_concurrency
+                       if name.startswith("sustained")
+                       else max(bucket_list)),
+                   "mode": leg.get("mode", "deadline"),
                    "rps": leg["rps"], "p50_ms": leg["p50_ms"],
                    "p99_ms": leg["p99_ms"],
                    "process_wall_s": leg["process_wall_s"],
@@ -194,6 +309,9 @@ def main(argv=None) -> int:
                    # waste, queue-wait fraction, burn rate, attainment)
                    # — bench_diff gates these under the slo_* bands
                    "slo": leg.get("slo"),
+                   # hot-swap provenance on the swap leg
+                   **({"swaps": leg["swaps"]} if leg.get("swaps")
+                      else {}),
                    "startup": leg["startup"],
                    "buckets": leg["buckets"]}
             for name, leg in legs.items()},
@@ -201,16 +319,29 @@ def main(argv=None) -> int:
         "notes": ("closed-loop client threads; latency = submit->answer "
                   "including queue+padding+device call; each leg is a "
                   "fresh process, so cache_hit_start_s is a true process "
-                  "restart against the persisted artifacts"),
+                  "restart against the persisted artifacts; sustained_* "
+                  "legs share one load shape — deadline batcher vs "
+                  "continuous batching vs continuous with live weight "
+                  "hot-swaps fired mid-load (swap leg gated against the "
+                  "control through the bench_diff p99/slo_* bands at "
+                  "bank time)"),
     }
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
         f.write("\n")
-    print(json.dumps({"out": args.out,
-                      "cold_start_s": artifact["cold_start_s"],
-                      "cache_hit_start_s": artifact["cache_hit_start_s"],
-                      "cold_rps": legs["cold"]["rps"],
-                      "warm_rps": legs["warm"]["rps"]}))
+    summary = {"out": args.out,
+               "cold_start_s": artifact["cold_start_s"],
+               "cache_hit_start_s": artifact["cache_hit_start_s"],
+               "cold_rps": legs["cold"]["rps"],
+               "warm_rps": legs["warm"]["rps"]}
+    if sustained is not None:
+        summary.update({
+            "deadline_rps": legs["sustained_deadline"]["rps"],
+            "continuous_rps": legs["sustained_control"]["rps"],
+            "swap_rps": legs["sustained_swap"]["rps"],
+            "swaps": sustained["swaps_completed"],
+            "swap_vs_control": sustained["swap_vs_control"]["verdict"]})
+    print(json.dumps(summary))
     return 0
 
 
