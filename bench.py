@@ -626,7 +626,26 @@ def worker(replicas: int, chunk: int, episodes: int,
     topo_mix = _topo_mix()
     mix_plan = None
     mix_samplers = None
+    factory = None
+    factory_probs = None
     if topo_mix:
+        from gsc_tpu.topology.factory import is_factory_mix
+        if is_factory_mix(topo_mix):
+            # on-device scenario factory: fresh per-replica scenarios
+            # SAMPLED per episode inside the measured loop (uniform
+            # family weights — bench has no curriculum; the trainer owns
+            # that loop) — the row measures the factory-inclusive
+            # steady-state rate
+            from gsc_tpu.topology.factory import (ScenarioFactory,
+                                                  parse_factory)
+            factory = ScenarioFactory(
+                parse_factory(topo_mix), env.sim_cfg, env.service,
+                EPISODE_STEPS, max_nodes=env.limits.max_nodes,
+                max_edges=env.limits.max_edges)
+            factory_probs = jnp.full(
+                (factory.spec.num_families,),
+                1.0 / factory.spec.num_families)
+    if topo_mix and factory is None:
         from gsc_tpu.topology import DEFAULT_REGISTRY, TopologyBucket
         from gsc_tpu.topology.scenarios import (build_mix_entries,
                                                 mix_device_samplers,
@@ -644,7 +663,10 @@ def worker(replicas: int, chunk: int, episodes: int,
     monitor = CompileMonitor().start()
     # traffic sampled ON DEVICE: at B=256 the old host-stacked schedule was
     # ~90 MB through the tunnel before the first measurement
-    if mix_plan is not None:
+    if factory is not None:
+        topo, traffic = factory.sample_batch(jax.random.PRNGKey(42),
+                                             factory_probs, B)
+    elif mix_plan is not None:
         mix_samplers = mix_device_samplers(mix_plan, env.sim_cfg,
                                            env.service, EPISODE_STEPS)
         traffic = jax.jit(
@@ -657,7 +679,8 @@ def worker(replicas: int, chunk: int, episodes: int,
             jax.random.PRNGKey(42))
     jax.block_until_ready(traffic)
     pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True, plan=plan,
-                         per_replica_topology=mix_plan is not None)
+                         per_replica_topology=(mix_plan is not None
+                                               or factory is not None))
 
     env_states, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
@@ -711,19 +734,34 @@ def worker(replicas: int, chunk: int, episodes: int,
         """Dispatch one full episode's device work (async).  Pipelined:
         every chunk goes through the fused chunk_step, the LAST one with
         learn=True — rollout tail and learn burst in one program.  Off:
-        the seed's two-call shape (per-chunk rollout + separate learn)."""
+        the seed's two-call shape (per-chunk rollout + separate learn).
+        Factory mixes RESAMPLE the per-replica scenario per episode
+        inside the measured phase (that is the factory's steady state —
+        a fixed-scenario factory row would measure the wrong thing)."""
+        tpo, tfc = topo, traffic
         with timer.phase("dispatch"):
+            if factory is not None:
+                tpo, tfc = factory.sample_batch(
+                    jax.random.fold_in(jax.random.PRNGKey(42), ep),
+                    factory_probs, B)
+                # fresh scenario => fresh env state: stepping carries
+                # evolved on the PREVIOUS topology against the new one
+                # would measure incoherent transitions and skip the
+                # per-episode reset the real factory train loop pays
+                env_states, obs = pddpg.reset_all(
+                    jax.random.fold_in(jax.random.PRNGKey(7), ep), tpo,
+                    tfc)
             for c in range(chunks_per_ep):
                 start = jnp.int32(ep * EPISODE_STEPS + c * chunk)
                 if pipeline:
                     state, buffers, env_states, obs, stats, metrics = \
                         pddpg.chunk_step(state, buffers, env_states, obs,
-                                         topo, traffic, start, chunk,
+                                         tpo, tfc, start, chunk,
                                          learn=(c == chunks_per_ep - 1))
                 else:
                     state, buffers, env_states, obs, stats = \
                         pddpg.rollout_episodes(state, buffers, env_states,
-                                               obs, topo, traffic, start,
+                                               obs, tpo, tfc, start,
                                                chunk)
             if not pipeline:
                 state, metrics = pddpg.learn_burst(state, buffers)
@@ -766,7 +804,8 @@ def worker(replicas: int, chunk: int, episodes: int,
             "jit_traces": {fn: t for fn, (t, _c)
                            in monitor.snapshot().items() if t and fn in
                            ("chunk_step", "rollout_episodes",
-                            "learn_burst", "reset_all")},
+                            "learn_burst", "reset_all",
+                            "factory_sample")},
             "episodes_measured": ep,
             "measure_wall_s": round(dt, 1),
             "phases": timer.summary(),

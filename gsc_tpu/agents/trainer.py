@@ -313,6 +313,18 @@ class Trainer:
                            topology=name)
         return {**(extra or {}), "topology": name}
 
+    @staticmethod
+    def _finite_host(tree) -> bool:
+        """Host-side all-finite scan over a (host-layout) pytree's
+        inexact leaves — the replica path's stand-in for the rollback
+        guard's on-device verdict.  ONE definition shared by the
+        periodic-checkpoint and hot-swap-publish gates in
+        ``train_parallel``, so the two paths can never diverge on what
+        counts as a poisoned state."""
+        return all(np.isfinite(np.asarray(leaf)).all()
+                   for leaf in jax.tree_util.tree_leaves(tree)
+                   if np.issubdtype(np.asarray(leaf).dtype, np.inexact))
+
     # -------------------------------------------------------- cost ledger
     @staticmethod
     def _ledger_fn(owner, name: str):
@@ -847,7 +859,8 @@ class Trainer:
                        init_state: Optional[DDPGState] = None,
                        init_buffers=None, start_episode: int = 0,
                        ckpt_manager=None, ckpt_interval: int = 0,
-                       preempt=None, plan=None):
+                       preempt=None, plan=None, publisher=None,
+                       publish_interval: int = 0, curriculum=None):
         """Replica-parallel training: B vmapped env replicas per episode on
         the scheduled topology, chunked rollouts + end-of-episode learn
         burst (the bench/learning-curve path), logged through the same
@@ -879,6 +892,30 @@ class Trainer:
         save boundaries and the final return below, where
         ``gather_state`` assembles the sharded leaves directly.
 
+        On-device scenario factory (``--topo-mix factory:...``): when
+        the driver carries a :class:`~gsc_tpu.topology.factory.
+        FactorySpec`, every episode SAMPLES a fresh per-replica
+        (topology, traffic, fault plan) inside one jitted
+        ``factory_sample`` call — the host-staged MixPlan products are
+        replaced by device tensors, the ``scenario_regen`` phase
+        collapses to dispatch-enqueue time, and nothing retraces (the
+        bucket's shapes are static).  Batch composition is steered by
+        the TD curriculum (:mod:`gsc_tpu.env.curriculum`, ``curriculum``
+        = a ``CurriculumConfig``): each drained episode's per-family
+        |TD| segment sums (the learn ledger's existing signal) update
+        per-family EWMAs whose softmax — floored with a uniform mix —
+        becomes the next episode's family-sampling weights
+        (``curriculum_weight{family=}`` gauges + ``curriculum`` events).
+        Without a learn ledger the weights stay uniform.
+
+        Train-while-serve: ``publisher`` + a positive
+        ``publish_interval`` publish the actor params every N episodes,
+        exactly like :meth:`train` — except this path's carries are
+        replica/mesh-sharded, so what ships is the HOST-GATHERED state
+        (the plan's gather fns under ``--mesh``), finite-verified
+        host-side first (this path has no rollback guard; a poisoned
+        state skips the publish loudly instead of reaching the fleet).
+
         Resilience on this path: preemption stop + periodic checkpoints
         (finite-verified host-side — there is no rollback guard here);
         fault injection is NOT wired through the replica harness, so a
@@ -901,7 +938,10 @@ class Trainer:
                                            start_episode=start_episode,
                                            ckpt_manager=ckpt_manager,
                                            ckpt_interval=ckpt_interval,
-                                           preempt=preempt, plan=plan)
+                                           preempt=preempt, plan=plan,
+                                           publisher=publisher,
+                                           publish_interval=publish_interval,
+                                           curriculum=curriculum)
         from ..parallel import ParallelDDPG
         from ..parallel.harness import run_chunked_episodes
         from ..sim.traffic_device import DeviceTraffic
@@ -920,15 +960,34 @@ class Trainer:
         # topology diversity fills the batch instead of costing wall-clock
         # episodes, and a "schedule switch" never recompiles (the switch
         # IS the per-replica topology tensor)
+        # on-device scenario factory (topology.factory): the driver's
+        # factory spec replaces the host MixPlan wholesale — scenarios
+        # are device tensors sampled per episode, steered by the TD
+        # curriculum below
+        factory = (self.driver.scenario_factory
+                   if getattr(self.driver, "factory_spec", None)
+                   is not None else None)
+        if factory is not None and not device_traffic:
+            raise ValueError(
+                "the scenario factory IS on-device sampling — "
+                "device_traffic=False has no host path to fall back to "
+                "(use a registry --topo-mix for host-generated traffic)")
         mix_plan = (self.driver.mix_plan(num_replicas)
-                    if getattr(self.driver, "topo_mix", None) else None)
+                    if getattr(self.driver, "topo_mix", None)
+                    and factory is None else None)
         if mix_plan is not None:
             from ..topology.scenarios import (mix_device_samplers,
                                               sample_mix_device)
+        curr = None
+        if factory is not None:
+            from ..env.curriculum import Curriculum, CurriculumConfig
+            curr = Curriculum(factory.family_names,
+                              curriculum or CurriculumConfig())
         pddpg = ParallelDDPG(self.env, self.agent_cfg,
                              num_replicas=num_replicas, donate=True,
                              gnn_impl=self.ddpg.actor.gnn_impl, plan=plan,
-                             per_replica_topology=mix_plan is not None,
+                             per_replica_topology=(mix_plan is not None
+                                                   or factory is not None),
                              learn_ledger=self.ddpg.learn_ledger)
         # learn-ledger segment names (topo_id -> name) for the harness's
         # per-episode learn_signal emit; None without a ledger
@@ -1003,6 +1062,20 @@ class Trainer:
         self._last_drained = start_episode - 1
         if self.obs:
             self.obs.resume_watchdog()
+
+        def _curriculum_hook(_i, _ret, _succ, metrics):
+            """Harness ``on_episode`` callback (factory mode): fold the
+            drained learn signal's per-family |TD| segments into the
+            curriculum EWMAs.  The harness drain already synced these
+            values — pure host arithmetic, never a device wait.  No
+            ledger (``--no-learn-obs``) => no signal => the weights stay
+            uniform (documented)."""
+            sig = (metrics or {}).get("learn_signal") \
+                if isinstance(metrics, dict) else None
+            if sig is not None:
+                curr.fold_td(np.asarray(sig["td_abs_sum"]),
+                             np.asarray(sig["td_count"]))
+
         start = time.time()
         try:
             # the scheduler may swap topologies mid-run, so drive the
@@ -1019,13 +1092,33 @@ class Trainer:
                         detail=f"stopping before episode {ep}; the caller "
                                "checkpoints the drained state")
                     break
-                # mixed mode: the stacked topology is the SAME pytree
-                # object every episode (driver memo), so the device
-                # placement memo and the compiled program both hit — the
-                # whole mixture trains with exactly one trace
-                topo = (mix_plan.topo if mix_plan is not None
-                        else self.driver.topology_for(ep))
-                traffic = episode_traffic(ep, topo)
+                # the scenario_regen phase measures what producing this
+                # episode's (topology, traffic) costs the HOST: the full
+                # Python regen wall on host-traffic paths, dispatch-
+                # enqueue time on device-sampling paths — the cost the
+                # factory deletes, measured instead of asserted
+                # (SCEN_r01 banks the before/after)
+                with phase_span("scenario_regen", timer, hub):
+                    if factory is not None:
+                        # fresh per-replica scenarios, entirely on
+                        # device: family weights from the curriculum
+                        # (tiny [K] host vector — data, never a compile
+                        # axis), keys by episode index like the device
+                        # traffic samplers
+                        probs = jax.numpy.asarray(curr.weights(),
+                                                  jax.numpy.float32)
+                        topo, traffic = factory.sample_batch(
+                            jax.random.fold_in(base, 2000 + ep), probs,
+                            num_replicas)
+                    else:
+                        # mixed mode: the stacked topology is the SAME
+                        # pytree object every episode (driver memo), so
+                        # the device placement memo and the compiled
+                        # program both hit — the whole mixture trains
+                        # with exactly one trace
+                        topo = (mix_plan.topo if mix_plan is not None
+                                else self.driver.topology_for(ep))
+                        traffic = episode_traffic(ep, topo)
                 if ep == start_episode and self.obs is not None \
                         and getattr(self.obs, "perf", None) is not None:
                     # cost-ledger capture for the replica path: shapes-only
@@ -1064,6 +1157,19 @@ class Trainer:
                             "learn_burst": (
                                 l_fn, (*l_pre, state, buffers), {}),
                         })
+                        if factory is not None:
+                            # the factory-inclusive program: the jitted
+                            # scenario sampler is episode device work
+                            # too — mine its HLO next to chunk_step.
+                            # The AOT lower shares the sampler jit's
+                            # trace cache (same jit object, same
+                            # shapes), so the capture never shows as a
+                            # spurious factory_sample retrace.
+                            self._capture_costs({
+                                "factory_sample": (
+                                    factory.lowerable(num_replicas),
+                                    (jax.random.PRNGKey(0), probs), {}),
+                            })
                         if plan is not None:
                             # ALSO capture the PARTITIONED executable the
                             # sharded dispatch actually runs: its HLO
@@ -1105,7 +1211,14 @@ class Trainer:
                     step_offset=ep * steps_per_ep, hub=hub, timer=timer,
                     topo_names=(mix_plan.names if mix_plan is not None
                                 else None),
-                    learn_names=seg_names)
+                    learn_names=seg_names,
+                    on_episode=(_curriculum_hook if curr is not None
+                                else None))
+                if curr is not None:
+                    # next episode's family weights, from THIS episode's
+                    # drained TD segments (the hook above updated the
+                    # EWMAs) — gauges + one curriculum event per episode
+                    curr.emit_weights(hub, ep)
                 sps = ((ep - start_episode + 1) * steps_per_ep
                        * num_replicas / (time.time() - start))
                 row = {"episodic_return": rets[0],
@@ -1123,10 +1236,13 @@ class Trainer:
                              ep, rets[0], succ[0], sps)
                 if self.obs:
                     extra = {"replicas": num_replicas}
-                    if mix_plan is None:
+                    if mix_plan is None and factory is None:
                         # homogeneous replica batches: one network per
                         # episode — same stamp as the serial drain (the
-                        # harness's per-replica names cover mixes)
+                        # harness's per-replica names cover mixes;
+                        # factory episodes attribute per FAMILY through
+                        # the learn ledger's topo_id segments, not a
+                        # schedule name)
                         extra = self._topology_extra(ep, rets[0],
                                                      extra=extra)
                     self.obs.episode_end(
@@ -1137,6 +1253,30 @@ class Trainer:
                         replay_bytes=buffer_nbytes(buffers),
                         extra=extra)
                 self._last_drained = ep
+                if (publisher is not None and publish_interval
+                        and (ep + 1 - start_episode) % publish_interval
+                        == 0):
+                    # hot-swap publish from the replica path (ROADMAP
+                    # item 3's last leftover): only the ACTOR subtree
+                    # ships, so gather exactly that — device_get
+                    # assembles sharded leaves to host arrays (the same
+                    # per-leaf move the plan's gather fns perform;
+                    # pulling the whole state would move ~5x the bytes,
+                    # and critic/targets/moments never serve).  With no
+                    # rollback guard here, finite-verify before
+                    # anything reaches the fleet.  Host gather at
+                    # publish cadence only, never per episode.
+                    params = jax.device_get(state.actor_params)
+                    if self._finite_host(params):
+                        publisher.publish(params, meta={"episode": ep + 1})
+                    else:
+                        self._recover(
+                            ep, site="learner_state", action="detected",
+                            fault="non_finite_state",
+                            detail="replica path has no rollback guard — "
+                                   "hot-swap publish skipped so a "
+                                   "poisoned state never reaches the "
+                                   "serving fleet")
                 if (ckpt_manager is not None and ckpt_interval
                         and (ep + 1 - start_episode) % ckpt_interval == 0):
                     # the replica harness drains synchronously, so the
@@ -1148,10 +1288,7 @@ class Trainer:
                     # needs these leaves on host anyway — under a plan
                     # the gather IS the mesh-agnostic checkpoint layout).
                     h_state, h_buffers = to_host(state, buffers)
-                    if all(np.isfinite(np.asarray(leaf)).all()
-                           for leaf in jax.tree_util.tree_leaves(h_state)
-                           if np.issubdtype(np.asarray(leaf).dtype,
-                                            np.inexact)):
+                    if self._finite_host(h_state):
                         ckpt_manager.save(h_state, h_buffers,
                                           episode=ep + 1)
                     else:

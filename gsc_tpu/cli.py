@@ -49,6 +49,12 @@ def _apply_jax_cache(flag_value):
     return d
 
 
+# (temperature, floor) — the ONE definition behind the two
+# --curriculum-* click defaults AND the flags-without-factory guard in
+# train(): a tuned default must keep both in lockstep, or every
+# non-factory run would trip the guard
+_CURRICULUM_DEFAULTS = (1.0, 0.25)
+
 _JAX_CACHE_HELP = (
     "persistent jax compilation cache directory (XLA executables are "
     "reused across processes — repeat runs skip identical compiles).  "
@@ -295,7 +301,15 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "'schedule,abilene+bursty,random12~link@3.0:7'.  One "
                    "compiled program serves the whole mixture — the "
                    "schedule 'switch' is just per-replica topology data, "
-                   "so nothing retraces")
+                   "so nothing retraces.  OR the on-device scenario "
+                   "factory: 'factory:<fam>[-<fam>...][+shapes][~faults]' "
+                   "(families star/ring/line/random, or 'all') samples a "
+                   "fresh randomized per-replica (topology, traffic, "
+                   "fault plan) INSIDE the compiled program every "
+                   "episode — zero host regen, zero retraces, an "
+                   "unbounded scenario distribution — with batch "
+                   "composition steered by the TD auto-curriculum "
+                   "(--curriculum-temperature/--curriculum-floor)")
 @click.option("--pipeline/--no-pipeline", default=True, show_default=True,
               help="asynchronous episode pipeline (--replicas 1 path): "
                    "background traffic prefetch, fused rollout+learn "
@@ -408,16 +422,33 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
               help="periodic checkpoints kept on disk (the last-good "
                    "pointer target is never pruned)")
 @click.option("--hot-swap-dir", default=None,
-              help="train-while-serve: publish the live actor params as "
+              help="train-while-serve: publish the actor params as "
                    "versioned, fingerprint-keyed hot-swap artifacts "
                    "(serve.fleet.WeightPublisher) into this directory "
                    "every --publish-interval drained-finite episodes — a "
                    "concurrently running `cli serve --hot-swap-dir` "
                    "fleet swaps each version in between dispatches.  "
-                   "Single-env path only (--replicas 1)")
+                   "--replicas 1 ships the rollback guard's VERIFIED "
+                   "snapshot; --replicas > 1 ships the host-gathered, "
+                   "finite-verified replica state (mesh-agnostic layout "
+                   "under --mesh, like the checkpoints)")
 @click.option("--publish-interval", default=1, show_default=True,
               help="episodes between hot-swap weight publishes "
                    "(with --hot-swap-dir)")
+@click.option("--curriculum-temperature", default=_CURRICULUM_DEFAULTS[0],
+              show_default=True,
+              help="TD auto-curriculum softmax temperature over the "
+                   "per-family |TD| EWMAs (factory --topo-mix only): "
+                   "lower = chase the generalization frontier harder, "
+                   "higher = flatter; infinity degenerates to "
+                   "round-robin-like uniform sampling")
+@click.option("--curriculum-floor", default=_CURRICULUM_DEFAULTS[1],
+              show_default=True,
+              help="total probability mass the auto-curriculum always "
+                   "spreads uniformly over the factory families (0..1): "
+                   "no family's sampling probability can fall below "
+                   "floor/K, so every family stays alive (forgetting "
+                   "stays visible)")
 @click.option("--jax-cache-dir", default=None, help=_JAX_CACHE_HELP)
 @click.option("--verbose/--quiet", default=True)
 def train(agent_config, simulator_config, service, scheduler, episodes, seed,
@@ -428,7 +459,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           obs_rotate_mb, perf_enabled, learnobs_enabled, metrics_port,
           watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
-          ckpt_retain, hot_swap_dir, publish_interval, jax_cache_dir,
+          ckpt_retain, hot_swap_dir, publish_interval,
+          curriculum_temperature, curriculum_floor, jax_cache_dir,
           verbose):
     """Train DDPG, checkpoint, then one greedy test episode
     (main.py:16-76).  With --runs N, trains N seeds and selects the best
@@ -462,13 +494,6 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         # same contract as bench.py's --unroll: fail fast with the flag's
         # name, not a SimConfig traceback from deep inside the run loop
         raise click.BadParameter("--unroll must be a positive integer")
-    if hot_swap_dir and replicas > 1:
-        # the publish hook lives in the single-env drain (the parallel
-        # path's state is replica/mesh-sharded — publishing it needs the
-        # plan's gather fns, which is the checkpoint path's job)
-        raise click.BadParameter("--hot-swap-dir publishes from the "
-                                 "single-env loop — drop --replicas or "
-                                 "serve from periodic checkpoints instead")
     if publish_interval < 1:
         raise click.BadParameter("--publish-interval must be >= 1")
     plan = None
@@ -511,14 +536,30 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             raise click.BadParameter(
                 "--topo-mix fills the replica axis with the mixture — it "
                 "requires the replica-parallel path (--replicas > 1)")
-        # grammar + registry-name validation BEFORE any expensive build;
-        # size/fit errors (a 53-node tinet in a 24-node bucket) surface
-        # from the driver's compile with the bucket dims in the message
-        from .topology.scenarios import DEFAULT_REGISTRY
+        # grammar + registry-name validation BEFORE any expensive build
+        # (factory: entries parse through topology.factory, everything
+        # else through the registry); size/fit errors (a 53-node tinet
+        # in a 24-node bucket) surface from the driver's compile with
+        # the bucket dims in the message
+        from .topology.scenarios import validate_mix
         try:
-            DEFAULT_REGISTRY.parse_mix(topo_mix)
+            validate_mix(topo_mix)
         except ValueError as e:
             raise click.BadParameter(f"--topo-mix: {e}")
+    from .topology.factory import is_factory_mix
+    curriculum_cfg = None
+    if is_factory_mix(topo_mix):
+        from .env.curriculum import CurriculumConfig
+        try:
+            curriculum_cfg = CurriculumConfig(
+                temperature=curriculum_temperature,
+                floor=curriculum_floor)
+        except ValueError as e:
+            raise click.BadParameter(str(e))
+    elif (curriculum_temperature, curriculum_floor) != _CURRICULUM_DEFAULTS:
+        raise click.BadParameter(
+            "--curriculum-* steers the on-device scenario factory — "
+            "pass --topo-mix factory:... or drop the flags")
     if resume == "auto":
         # newest checksummed checkpoint under the result root that still
         # validates — a corrupted newest (half-written at the kill, bit
@@ -633,6 +674,10 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
                             "topo_mix": topo_mix,
+                            **({"curriculum": {
+                                "temperature": curriculum_temperature,
+                                "floor": curriculum_floor}}
+                               if curriculum_cfg is not None else {}),
                             "precision": agent.precision,
                             # the EFFECTIVE engine knobs (yaml or flag),
                             # read back from the built sim_cfg so the
@@ -707,6 +752,12 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             # SIGTERM/SIGINT during training stop the loop at the next
             # episode boundary; the snapshot + clean exit happen below
             with PreemptionGuard() as guard:
+                publisher = None
+                if hot_swap_dir:
+                    from .serve.fleet import WeightPublisher
+                    publisher = WeightPublisher(
+                        hot_swap_dir,
+                        hub=(obs.hub if obs is not None else None))
                 if replicas > 1:
                     state, buffer = trainer.train_parallel(
                         episodes, num_replicas=replicas, chunk=chunk,
@@ -714,14 +765,11 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                         init_state=init_state, init_buffers=init_buffer,
                         start_episode=start_episode,
                         ckpt_manager=manager, ckpt_interval=ckpt_interval,
-                        preempt=guard, plan=plan)
+                        preempt=guard, plan=plan, publisher=publisher,
+                        publish_interval=(publish_interval
+                                          if hot_swap_dir else 0),
+                        curriculum=curriculum_cfg)
                 else:
-                    publisher = None
-                    if hot_swap_dir:
-                        from .serve.fleet import WeightPublisher
-                        publisher = WeightPublisher(
-                            hot_swap_dir,
-                            hub=(obs.hub if obs is not None else None))
                     state, buffer = trainer.train(
                         episodes, verbose=verbose, profile=profile,
                         init_state=init_state, init_buffer=init_buffer,

@@ -100,13 +100,41 @@ class EpisodeDriver:
         self.bucket = TopologyBucket(max_nodes, max_edges)
         self._mix_entries = None
         self._mix_plans = {}
-        if topo_mix:
+        # ``factory:`` mixes select the on-device scenario factory
+        # (topology.factory): no host mix entries exist — every episode
+        # SAMPLES fresh per-replica scenarios inside the compiled
+        # program, with batch composition steered by the TD curriculum
+        # (env.curriculum).  The spec parses here (fail fast on grammar)
+        # but the ScenarioFactory builds lazily: constructing it touches
+        # jax device constants, which drivers built only for validation
+        # should never pay.
+        self.factory_spec = None
+        self._factory = None
+        from ..topology.factory import is_factory_mix, parse_factory
+        if topo_mix and is_factory_mix(topo_mix):
+            self.factory_spec = parse_factory(topo_mix)
+        elif topo_mix:
             sched_names = [os.path.basename(p) for p in
                            scheduler.training_network_files]
             self._mix_entries = scenarios.build_mix_entries(
                 topo_mix, self.registry, self.bucket,
                 schedule_topos=self.topologies,
                 schedule_names=sched_names, dt=sim_cfg.dt)
+
+    @property
+    def scenario_factory(self):
+        """The driver's :class:`~gsc_tpu.topology.factory.
+        ScenarioFactory` (built on first access; None without a
+        ``factory:`` mix)."""
+        if self.factory_spec is None:
+            return None
+        if self._factory is None:
+            from ..topology.factory import ScenarioFactory
+            self._factory = ScenarioFactory(
+                self.factory_spec, self.sim_cfg, self.service,
+                self.episode_steps, max_nodes=self.bucket.max_nodes,
+                max_edges=self.bucket.max_edges)
+        return self._factory
 
     # ------------------------------------------------------------ mix mode
     def mix_plan(self, num_replicas: int) -> "scenarios.MixPlan":
@@ -115,6 +143,10 @@ class EpisodeDriver:
         vmapped dispatch never re-places or retraces it)."""
         if not self.topo_mix:
             raise ValueError("driver has no topo_mix configured")
+        if self.factory_spec is not None:
+            raise ValueError(
+                "a factory mix samples scenarios on device per episode — "
+                "no host MixPlan exists (use driver.scenario_factory)")
         plan = self._mix_plans.get(num_replicas)
         if plan is None:
             plan = scenarios.plan_mix(self._mix_entries, num_replicas,
@@ -150,7 +182,11 @@ class EpisodeDriver:
         can stamp into replay rows: mix-entry count for mixed runs,
         schedule length otherwise (the learn ledger's segment axis).
         ``getattr`` tolerates stub drivers built via ``__new__`` (the
-        test suite's single-topology fakes)."""
+        test suite's single-topology fakes).  Factory mixes segment per
+        FAMILY (``topo_id`` = family index)."""
+        spec = getattr(self, "factory_spec", None)
+        if spec is not None:
+            return spec.num_families
         entries = getattr(self, "_mix_entries", None)
         if entries is not None:
             return len(entries)
@@ -170,8 +206,11 @@ class EpisodeDriver:
     @property
     def topo_id_names(self) -> List[str]:
         """``topo_id`` -> human-readable name, aligned with
-        :attr:`num_topo_ids` (mix-entry names, else the schedule
-        names)."""
+        :attr:`num_topo_ids` (factory family names, mix-entry names,
+        else the schedule names)."""
+        spec = getattr(self, "factory_spec", None)
+        if spec is not None:
+            return list(spec.families)
         entries = getattr(self, "_mix_entries", None)
         if entries is not None:
             return [e.name for e in entries]

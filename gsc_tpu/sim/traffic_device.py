@@ -51,6 +51,89 @@ from .state import TrafficSchedule
 from .traffic import TraceEvents, traffic_capacity
 
 
+def renewal_stream(cfg: SimConfig, means, active, next_active,
+                   horizon: float, capacity: int, n_sfcs: int,
+                   ttl_choices, eg_table, eg_count: int, key):
+    """The renewal merge scan shared by :class:`DeviceTraffic` and the
+    on-device scenario factory (:mod:`gsc_tpu.topology.factory`): one
+    global arrival stream merged over per-node renewal clocks, semantics
+    per the module docstring.  ``means``/``active``/``next_active`` are
+    ``[steps, N]`` interval tables (host-precomputed constants for
+    DeviceTraffic, traced values conditioned on a sampled topology for
+    the factory); ``capacity``/``n_sfcs``/``eg_count`` are static.
+    Returns the 7 flow-record arrays of a :class:`TrafficSchedule`
+    (times, ingress, dr, duration, ttl, sfc, egress)."""
+    steps, n = active.shape
+    rd = jnp.float32(cfg.run_duration)
+
+    # first arrival: start of each node's first active interval
+    # (flowsimulator.py:63-70 emits at t=0; a trace-deactivated start
+    # jumps forward, traffic.py:198-211)
+    na0 = next_active[0]
+    t_init = jnp.where(na0 < steps, na0.astype(jnp.float32) * rd,
+                       jnp.inf)
+
+    node_ids = jnp.arange(n)
+
+    def emit(carry, slot):
+        t_next = carry
+        ks = jax.random.split(jax.random.fold_in(key, slot), 6)
+        t = jnp.min(t_next)
+        w = jnp.argmin(t_next)          # ties -> lowest node index,
+        oh_w = node_ids == w            # matching the host tie-break
+        valid = t < horizon
+        kk = jnp.clip((t / rd).astype(jnp.int32), 0, steps - 1)
+        mean_w = jnp.where(oh_w, means[kk], 0.0).sum()
+
+        # advance the winner's renewal clock
+        gap = jnp.where(cfg.deterministic_arrival, mean_w,
+                        mean_w * jax.random.exponential(ks[0]))
+        tp = t + gap
+        k2 = (tp / rd).astype(jnp.int32)
+        ended = (~jnp.isfinite(tp)) | (k2 >= steps)
+        k2c = jnp.clip(k2, 0, steps - 1)
+        act2 = jnp.where(oh_w, active[k2c], False).any()
+        na = jnp.where(oh_w, next_active[k2c], steps).min()
+        t_jump = jnp.where(na < steps, na.astype(jnp.float32) * rd,
+                           jnp.inf)
+        t_new = jnp.where(ended, jnp.inf, jnp.where(act2, tp, t_jump))
+        t_next = jnp.where(oh_w, t_new, t_next)
+
+        # flow attributes (default_generator.py:30-60)
+        drs = cfg.flow_dr_mean + cfg.flow_dr_stdev * \
+            jax.random.normal(ks[1], (8,))
+        ok = drs >= 0.0
+        dr = jnp.where(ok.any(), drs[jnp.argmax(ok)], jnp.abs(drs[-1]))
+        size = jnp.where(cfg.deterministic_size,
+                         jnp.float32(cfg.flow_size_shape),
+                         jax.random.pareto(
+                             ks[2], jnp.float32(cfg.flow_size_shape)))
+        dur = jnp.where(dr > 0, size / jnp.maximum(dr, 1e-30) * 1000.0,
+                        0.0)
+        ttl = ttl_choices[jax.random.randint(
+            ks[3], (), 0, ttl_choices.shape[0])]
+        sfc = jax.random.randint(ks[4], (), 0, n_sfcs)
+        if eg_count:
+            eg = eg_table[jax.random.randint(ks[5], (), 0, eg_count)]
+        else:
+            eg = jnp.int32(-1)
+        row = (jnp.where(valid, t, jnp.inf),
+               jnp.where(valid, w, 0).astype(jnp.int32),
+               jnp.where(valid, dr, 0.0),
+               jnp.where(valid, dur, 0.0),
+               jnp.where(valid, ttl, 0.0),
+               jnp.where(valid, sfc, 0).astype(jnp.int32),
+               jnp.where(valid, eg, -1).astype(jnp.int32))
+        return t_next, row
+
+    # the merge scan is `capacity` tiny sequential steps (12.8k on the
+    # flagship): unrolling amortizes the per-iteration loop overhead,
+    # which dominates a body this small on TPU
+    _, rows = jax.lax.scan(emit, t_init, jnp.arange(capacity),
+                           unroll=8 if capacity % 8 == 0 else 1)
+    return rows
+
+
 class DeviceTraffic:
     """Per-scenario traffic sampler whose ``sample(key)`` is jittable and
     vmappable.  Build once per (config, service, topology, trace); call
@@ -166,79 +249,12 @@ class DeviceTraffic:
 
     def sample(self, key) -> TrafficSchedule:
         """One episode of traffic, entirely on device.  jit/vmap freely."""
-        cfg = self.cfg
-        steps, n = self.active.shape
-        rd = jnp.float32(cfg.run_duration)
         k_means, k_flows = jax.random.split(key)
         means = self._interval_means(k_means)
-
-        # first arrival: start of each node's first active interval
-        # (flowsimulator.py:63-70 emits at t=0; a trace-deactivated start
-        # jumps forward, traffic.py:198-211)
-        na0 = self.next_active[0]
-        t_init = jnp.where(na0 < steps, na0.astype(jnp.float32) * rd,
-                           jnp.inf)
-
-        node_ids = jnp.arange(n)
-
-        def emit(carry, slot):
-            t_next = carry
-            ks = jax.random.split(jax.random.fold_in(k_flows, slot), 6)
-            t = jnp.min(t_next)
-            w = jnp.argmin(t_next)          # ties -> lowest node index,
-            oh_w = node_ids == w            # matching the host tie-break
-            valid = t < self.horizon
-            kk = jnp.clip((t / rd).astype(jnp.int32), 0, steps - 1)
-            mean_w = jnp.where(oh_w, means[kk], 0.0).sum()
-
-            # advance the winner's renewal clock
-            gap = jnp.where(cfg.deterministic_arrival, mean_w,
-                            mean_w * jax.random.exponential(ks[0]))
-            tp = t + gap
-            k2 = (tp / rd).astype(jnp.int32)
-            ended = (~jnp.isfinite(tp)) | (k2 >= steps)
-            k2c = jnp.clip(k2, 0, steps - 1)
-            act2 = jnp.where(oh_w, self.active[k2c], False).any()
-            na = jnp.where(oh_w, self.next_active[k2c], steps).min()
-            t_jump = jnp.where(na < steps, na.astype(jnp.float32) * rd,
-                               jnp.inf)
-            t_new = jnp.where(ended, jnp.inf, jnp.where(act2, tp, t_jump))
-            t_next = jnp.where(oh_w, t_new, t_next)
-
-            # flow attributes (default_generator.py:30-60)
-            drs = cfg.flow_dr_mean + cfg.flow_dr_stdev * \
-                jax.random.normal(ks[1], (8,))
-            ok = drs >= 0.0
-            dr = jnp.where(ok.any(), drs[jnp.argmax(ok)], jnp.abs(drs[-1]))
-            size = jnp.where(cfg.deterministic_size,
-                             jnp.float32(cfg.flow_size_shape),
-                             jax.random.pareto(
-                                 ks[2], jnp.float32(cfg.flow_size_shape)))
-            dur = jnp.where(dr > 0, size / jnp.maximum(dr, 1e-30) * 1000.0,
-                            0.0)
-            ttl = self.ttl_choices[jax.random.randint(
-                ks[3], (), 0, self.ttl_choices.shape[0])]
-            sfc = jax.random.randint(ks[4], (), 0, self.n_sfcs)
-            if self.eg_count:
-                eg = self.eg_table[jax.random.randint(
-                    ks[5], (), 0, self.eg_count)]
-            else:
-                eg = jnp.int32(-1)
-            row = (jnp.where(valid, t, jnp.inf),
-                   jnp.where(valid, w, 0).astype(jnp.int32),
-                   jnp.where(valid, dr, 0.0),
-                   jnp.where(valid, dur, 0.0),
-                   jnp.where(valid, ttl, 0.0),
-                   jnp.where(valid, sfc, 0).astype(jnp.int32),
-                   jnp.where(valid, eg, -1).astype(jnp.int32))
-            return t_next, row
-
-        # the merge scan is `capacity` tiny sequential steps (12.8k on the
-        # flagship): unrolling amortizes the per-iteration loop overhead,
-        # which dominates a body this small on TPU
-        _, (times, ingress, drs, durs, ttls, sfcs, egs) = jax.lax.scan(
-            emit, t_init, jnp.arange(self.capacity),
-            unroll=8 if self.capacity % 8 == 0 else 1)
+        times, ingress, drs, durs, ttls, sfcs, egs = renewal_stream(
+            self.cfg, means, self.active, self.next_active, self.horizon,
+            self.capacity, self.n_sfcs, self.ttl_choices, self.eg_table,
+            self.eg_count, k_flows)
         return TrafficSchedule(
             arr_time=times, arr_ingress=ingress, arr_dr=drs,
             arr_duration=durs, arr_ttl=ttls, arr_sfc=sfcs, arr_egress=egs,

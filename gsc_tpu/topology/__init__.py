@@ -23,6 +23,13 @@ from .scenarios import (
     build_mix_entries,
     parse_topo_faults,
     plan_mix,
+    validate_mix,
+)
+from .factory import (
+    FactorySpec,
+    ScenarioFactory,
+    is_factory_mix,
+    parse_factory,
 )
 
 __all__ = [
@@ -31,5 +38,6 @@ __all__ = [
     "load_topology_cached", "read_graphml", "stack_topologies",
     "synthetic", "scenarios", "DEFAULT_REGISTRY", "MixEntry", "MixPlan",
     "Scenario", "ScenarioRegistry", "TopoFault", "build_mix_entries",
-    "parse_topo_faults", "plan_mix",
+    "parse_topo_faults", "plan_mix", "validate_mix", "FactorySpec",
+    "ScenarioFactory", "is_factory_mix", "parse_factory",
 ]

@@ -321,6 +321,20 @@ class ScenarioRegistry:
 DEFAULT_REGISTRY = ScenarioRegistry()
 
 
+def validate_mix(mix: str, registry: Optional[ScenarioRegistry] = None):
+    """Grammar validation for BOTH mix forms — the one entry point cli
+    and bench call before any expensive build.  ``factory:`` mixes parse
+    through :mod:`~gsc_tpu.topology.factory` (on-device sampled
+    scenarios, the whole replica axis); everything else is a registry
+    mix through :meth:`ScenarioRegistry.parse_mix`.  Returns the parsed
+    ``FactorySpec`` or entry list."""
+    from . import factory as _factory
+
+    if _factory.is_factory_mix(mix):
+        return _factory.parse_factory(mix)
+    return (registry or DEFAULT_REGISTRY).parse_mix(mix)
+
+
 # ------------------------------------------------------------- mix planning
 @dataclass
 class MixEntry:

@@ -100,8 +100,8 @@ METRIC_RULES: List[Tuple] = [
 # at results/<id>/<timestamp>/ (utils.experiment.setup_result_dir
 # layout), arbitrarily deep below the scan root.
 SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
-                 "MIXTOPO_r*.json", "**/perf.json", "**/curves.json",
-                 "**/slo.json")
+                 "MIXTOPO_r*.json", "SCEN_r*.json", "**/perf.json",
+                 "**/curves.json", "**/slo.json")
 
 
 def metric_rule(name: str) -> Optional[Tuple[bool, float, float]]:
@@ -129,16 +129,20 @@ def _bench_row(d: Dict) -> Dict:
             metrics["env_steps_per_sec"] = float(d["value"])
         if _num(d.get("vs_baseline")) is not None:
             metrics["vs_baseline"] = float(d["vs_baseline"])
-        # MIXTOPO rounds share the metric name but report paired rates
-        for k in ("mixed_sps", "homogeneous_sps", "mixed_vs_homogeneous"):
+        # MIXTOPO/SCEN rounds share the metric name but report paired
+        # rates: the `_sps` suffix gates them under the 15% rate band;
+        # the ratios and the scenario_regen walls are context
+        for k in ("mixed_sps", "homogeneous_sps", "mixed_vs_homogeneous",
+                  "factory_sps", "host_regen_sps", "factory_vs_host",
+                  "factory_scenario_regen_s", "host_scenario_regen_s"):
             if _num(d.get(k)) is not None:
                 metrics[k] = float(d[k])
         for fn, n in (d.get("jit_traces") or {}).items():
             if _num(n) is not None:
                 metrics[f"{fn}_jit_traces"] = float(n)
-        # MIXTOPO rounds record per-leg trace counts; keys end in
+        # MIXTOPO/SCEN rounds record per-leg trace counts; keys end in
         # `_jit_traces` so the 0%-tolerance retrace band gates them too
-        for leg in ("homogeneous", "mixed"):
+        for leg in ("homogeneous", "mixed", "factory", "host_regen"):
             for fn, n in (d.get(f"jit_traces_{leg}") or {}).items():
                 if _num(n) is not None:
                     metrics[f"{leg}_{fn}_jit_traces"] = float(n)
@@ -629,6 +633,35 @@ def selftest() -> int:
         assert d["verdict"] == "regression" \
             and "warm_slo_deadline_miss_ratio" in d["regressions"], d
 
+        # SCEN rounds (on-device scenario factory vs host regen): the
+        # paired `_sps` rates gate under the throughput band, per-leg
+        # trace counts under the 0% retrace band, the ratio + deleted
+        # scenario_regen walls stay informational context
+        scen = dump("SCEN_r95.json", {
+            "metric": "env_steps_per_sec_per_chip", "status": "ok",
+            "factory_sps": 30.0, "host_regen_sps": 24.0,
+            "factory_vs_host": 1.25, "factory_scenario_regen_s": 0.02,
+            "host_scenario_regen_s": 1.9,
+            "jit_traces_factory": {"chunk_step": 1, "factory_sample": 1},
+            "jit_traces_host_regen": {"chunk_step": 1}})
+        scrow = extract_row(scen)
+        assert scrow["metrics"]["factory_sps"] == 30.0 \
+            and scrow["metrics"]["host_regen_sps"] == 24.0, \
+            scrow["metrics"]
+        assert scrow["metrics"]["factory_factory_sample_jit_traces"] \
+            == 1.0, scrow["metrics"]
+        d = diff_rows({**scrow, "name": "scen_self"},
+                      {**scrow, "name": "scen_base"})
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        assert d["metrics"]["factory_vs_host"]["verdict"] \
+            == "informational", d["metrics"]["factory_vs_host"]
+        slower_scen = dict(scrow, name="scen_slow",
+                           metrics={**scrow["metrics"],
+                                    "factory_sps": 20.0})
+        d = diff_rows(slower_scen, {**scrow, "name": "scen_base"})
+        assert d["verdict"] == "regression" \
+            and "factory_sps" in d["regressions"], d
+
         # a widened tolerance declassifies a small regression
         d = diff_rows({"name": "a", "metrics": {"x_mfu": 0.9}},
                       {"name": "b", "metrics": {"x_mfu": 1.0}},
@@ -684,7 +717,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                         "cumulative trajectory")
     ing.add_argument("paths", nargs="*", help="artifact files")
     ing.add_argument("--scan", default=None,
-                     help="also glob BENCH_r*/MULTICHIP_r*/SERVE_r*/"
+                     help="also glob BENCH_r*/MULTICHIP_r*/SERVE_r*/SCEN_r*/"
                           "perf.json/curves.json/slo.json under this "
                           "directory")
     ing.add_argument("--out", default="BENCH_TRAJECTORY.json")

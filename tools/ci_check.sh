@@ -101,6 +101,16 @@ echo "== fleet smoke (continuous batching + hot-swap under load, 2 workers) =="
 # rc 1) — tools/fleet_smoke.py asserts all of it
 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
+echo "== scenario smoke (on-device factory + auto-curriculum, zero retraces) =="
+# a tiny 3-episode factory train run (--topo-mix factory:... --no-perf)
+# must rc=0 with EXACTLY one trace each for factory_sample/reset_all/
+# chunk_step across the randomized scenario stream, one curriculum event
+# per episode with floored weights, curriculum_weight{family=} gauges in
+# metrics.json AND over a live /metrics scrape, and a SCEN-shaped row
+# gating through bench_diff (self-compare rc 0, injected env-steps/s
+# regression rc 1) — tools/scenario_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/scenario_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

@@ -874,7 +874,15 @@ def _synthetic_events(path: str, episodes: int = 5):
                       "dispatch": {"total_s": round(disp, 4),
                                    "count": ep + 1, "mean_ms": 10.0},
                       "drain": {"total_s": round(drain, 4),
-                                "count": ep + 1, "mean_ms": 2.0}},
+                                "count": ep + 1, "mean_ms": 2.0},
+                      # per-episode scenario production (the cost the
+                      # on-device factory deletes) rides the generic
+                      # phase columns — locked in here so the rendering
+                      # never silently drops it
+                      "scenario_regen": {"total_s": round(0.01 * (ep + 1),
+                                                          4),
+                                         "count": ep + 1,
+                                         "mean_ms": 10.0}},
                   # 64 MiB -> 64+96*ep MiB: well past floor + threshold;
                   # the second device has NO allocator stats (the CPU
                   # memory_stats()=None shape) — the report must call
