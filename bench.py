@@ -211,6 +211,23 @@ def _partition_rules() -> str:
         raise SystemExit(f"GSC_BENCH_PARTITION_RULES: {e}")
 
 
+def _async_actors() -> int:
+    """Decoupled actor/learner dispatch (``--async-actors`` /
+    GSC_BENCH_ASYNC_ACTORS): 0 (default) measures the synchronous episode
+    loop every earlier round banked; N>0 routes the measured window
+    through parallel.async_rl.run_async with N rollout threads feeding
+    the device-resident replay ring while the learner runs bursts
+    back-to-back.  Rows record ``async_actors`` (plus the learner-idle
+    fraction on the final row) so async rates never mix with sync ones in
+    trajectory tooling — tools/async_bench.py owns the gated sync-vs-
+    async comparison artifact; this knob lets the official ladder bank an
+    async chip rate without a code edit once that gate is green."""
+    n = _env_int("GSC_BENCH_ASYNC_ACTORS", 0)
+    if n < 0:
+        raise SystemExit(f"GSC_BENCH_ASYNC_ACTORS={n} must be >= 0")
+    return n
+
+
 def ladder():
     """The (replicas, chunk, timeout) escalation ladder.  GSC_BENCH_LADDER
     ("B,chunk,timeout[;B,chunk,timeout...]") overrides it — the CPU smoke
@@ -678,7 +695,28 @@ def worker(replicas: int, chunk: int, episodes: int,
         traffic = jax.jit(lambda k: dt_sampler.sample_batch(k, B))(
             jax.random.PRNGKey(42))
     jax.block_until_ready(traffic)
-    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=True, plan=plan,
+    async_actors = _async_actors()
+    if async_actors:
+        # same refusals as cli train --async, failing fast with the knob's
+        # name: the sharded dispatch memoizes device placements the actor
+        # threads would race, and the cost capture assumes the sync
+        # dispatch entry points
+        if mesh_spec:
+            raise SystemExit("GSC_BENCH_ASYNC_ACTORS does not compose with "
+                             "GSC_BENCH_MESH yet — drop one of the two")
+        if _env_int("GSC_BENCH_PERF", 0):
+            raise SystemExit("GSC_BENCH_ASYNC_ACTORS does not compose with "
+                             "GSC_BENCH_PERF (the cost capture lowers the "
+                             "sync dispatch entry point)")
+        # the async path has no fused chunk_step — actors dispatch
+        # rollout_episodes, the learner dispatches learn_burst; rows
+        # record pipeline=False so they never read as fused-dispatch rates
+        pipeline = False
+    # donate=False on the async path: actors hand scratch blocks to the
+    # learner BY REFERENCE between threads — the one donated call is the
+    # learner-owned replay_ingest inside run_async
+    pddpg = ParallelDDPG(env, agent, num_replicas=B,
+                         donate=(async_actors == 0), plan=plan,
                          per_replica_topology=(mix_plan is not None
                                                or factory is not None))
 
@@ -686,6 +724,100 @@ def worker(replicas: int, chunk: int, episodes: int,
     one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
     state = pddpg.init(jax.random.PRNGKey(1), one_obs)
     buffers = pddpg.init_buffers(one_obs)
+
+    if async_actors:
+        # decoupled actor/learner measurement: N rollout threads feed the
+        # device-resident ring through run_async while the learner bursts
+        # back-to-back.  Warmup = one episode per actor (compiles every
+        # entry point: reset_all / rollout_episodes actor-side,
+        # replay_ingest / learn_burst learner-side); the measured window
+        # then banks a running rate per drained episode — same
+        # partial-credit-on-timeout contract as the sync loop.
+        from gsc_tpu.obs.device import device_memory_snapshot
+        from gsc_tpu.parallel.async_rl import AsyncConfig, run_async
+        from gsc_tpu.utils.telemetry import PhaseTimer
+
+        def scenario_fn(ep):
+            if factory is not None:
+                # per-episode resample, same steady state the sync
+                # factory rows measure
+                return factory.sample_batch(
+                    jax.random.fold_in(jax.random.PRNGKey(42), ep),
+                    factory_probs, B)
+            # fixed scenario, same as the sync loop's reuse of the one
+            # sampled schedule
+            return topo, traffic
+
+        cfg = AsyncConfig(actor_threads=async_actors)
+        res = run_async(pddpg, scenario_fn, state, buffers,
+                        episodes=async_actors,
+                        episode_steps=EPISODE_STEPS, chunk=chunk, seed=0,
+                        cfg=cfg)
+        state, buffers = res.state, res.buffers
+        print(f"[worker] compile+warmup: {time.time() - t_start:.1f}s",
+              file=sys.stderr)
+
+        timer = PhaseTimer()   # fresh ledger: warmup wall excluded
+        t0 = time.time()
+        row = {
+            "metric": "env_steps_per_sec_per_chip",
+            "unit": "env-steps/s",
+            "replicas": B, "chunk": chunk, "scenario": scenario,
+            "pipeline": False, "precision": precision,
+            "substep_impl": substep_impl, "unroll": unroll,
+            "mesh": None, "topo_mix": topo_mix,
+            "async_actors": async_actors,
+            **({"knobs": knobs} if knobs else {}),
+        }
+        drained_n = [0]
+
+        def on_episode(rec, ring):
+            drained_n[0] += 1
+            dt = time.time() - t0
+            print(json.dumps({
+                **row,
+                "value": round(drained_n[0] * EPISODE_STEPS * B / dt, 1),
+                "jit_traces": {fn: t for fn, (t, _c)
+                               in monitor.snapshot().items() if t and fn in
+                               ("rollout_episodes", "learn_burst",
+                                "reset_all", "factory_sample",
+                                "replay_ingest")},
+                "episodes_measured": drained_n[0],
+                "measure_wall_s": round(dt, 1),
+                "phases": timer.summary(),
+            }), flush=True)
+
+        res = run_async(pddpg, scenario_fn, state, buffers,
+                        episodes=async_actors + episodes,
+                        episode_steps=EPISODE_STEPS, chunk=chunk, seed=0,
+                        cfg=cfg, timer=timer, on_episode=on_episode,
+                        start_episode=async_actors)
+        dt = time.time() - t0
+        mem = device_memory_snapshot()
+        # final row = the banked one (the orchestrator parses the LAST
+        # line with a value): full-window rate + the drain-proved learner
+        # accounting the async claim rests on
+        print(json.dumps({
+            **row,
+            "value": round(episodes * EPISODE_STEPS * B / dt, 1),
+            "jit_traces": {fn: t for fn, (t, _c)
+                           in monitor.snapshot().items() if t and fn in
+                           ("rollout_episodes", "learn_burst",
+                            "reset_all", "factory_sample",
+                            "replay_ingest")},
+            "episodes_measured": episodes,
+            "measure_wall_s": round(dt, 1),
+            "phases": timer.summary(),
+            "device_mem": [m for m in mem if m.get("available")],
+            "learner_idle_frac": res.info.get("learner_idle_frac"),
+            "bursts": res.info.get("bursts"),
+            "produced_steps": res.info.get("produced_steps"),
+            "ingested_steps": res.info.get("ingested_steps"),
+            "policy_lag_max": res.info.get("policy_lag_max"),
+        }), flush=True)
+        print(f"[worker] phase timings: {json.dumps(timer.summary())}",
+              file=sys.stderr)
+        return
 
     # opt-in device-cost ledger (--perf / GSC_BENCH_PERF=1): compile-time
     # FLOPs / bytes / fusion counts of the measured dispatch kernel ride
@@ -894,6 +1026,22 @@ if __name__ == "__main__":
             raise SystemExit(f"--unroll expects a positive integer, "
                              f"got {val!r}")
         os.environ["GSC_BENCH_SCAN_UNROLL"] = str(unroll)
+        del argv[i:i + 2]
+    if "--async-actors" in argv:
+        # forwarded like --unroll; a missing/garbled value must ERROR —
+        # a silently-sync row would mislabel a run meant to measure the
+        # decoupled actor/learner path
+        i = argv.index("--async-actors")
+        val = argv[i + 1] if i + 1 < len(argv) else None
+        try:
+            n_act = int(val)
+        except (TypeError, ValueError):
+            raise SystemExit(f"--async-actors expects a non-negative "
+                             f"integer, got {val!r}")
+        if n_act < 0:
+            raise SystemExit(f"--async-actors expects a non-negative "
+                             f"integer, got {val!r}")
+        os.environ["GSC_BENCH_ASYNC_ACTORS"] = str(n_act)
         del argv[i:i + 2]
     if "--mesh" in argv:
         # forwarded to worker subprocesses via the environment like

@@ -92,7 +92,7 @@ def buffer_add(buf: ReplayBuffer, item: Any) -> ReplayBuffer:
                         shapes=buf.shapes)
 
 
-def buffer_nbytes(buf: ReplayBuffer) -> int:
+def buffer_nbytes(buf: ReplayBuffer, local: bool = False) -> int:
     """Total replay storage footprint in bytes.  The buffer is the largest
     HBM resident of a training run; the pipeline telemetry logs this so the
     copy traffic that ``donate_argnums`` eliminates (one full-buffer copy
@@ -103,9 +103,39 @@ def buffer_nbytes(buf: ReplayBuffer) -> int:
     (bf16 obs/action leaves next to f32 reward/done, PrecisionPolicy.
     replay_dtype) the ``replay bytes`` gauge must reflect the halved
     residency, not double-count bf16 leaves as f32
-    (tests/test_precision.py::test_buffer_nbytes_mixed_dtypes)."""
-    return sum(l.size * l.dtype.itemsize
-               for l in jax.tree_util.tree_leaves(buf.data))
+    (tests/test_precision.py::test_buffer_nbytes_mixed_dtypes).
+
+    ``local=True`` reports the bytes RESIDENT ON THIS PROCESS'S devices
+    when the ring is dp-sharded under a mesh plan: ``l.size`` on a jax
+    Array is the GLOBAL element count, so the default accounting
+    overstates a sharded ring's per-host residency by the dp factor —
+    local sums each leaf's addressable shards instead (identical to the
+    global number for host numpy leaves and unsharded device arrays)."""
+    total = 0
+    for l in jax.tree_util.tree_leaves(buf.data):
+        shards = getattr(l, "addressable_shards", None) if local else None
+        if shards is not None:
+            total += sum(s.data.size * s.data.dtype.itemsize
+                         for s in shards)
+        else:
+            total += l.size * l.dtype.itemsize
+    return total
+
+
+def buffer_fill_frac(buf: ReplayBuffer) -> float:
+    """Global fill fraction of the ring: valid entries over capacity,
+    summed across every replica row when ``size`` is batched [B] (the
+    parallel ring) and correct when ``size``/``data`` live sharded under
+    a plan — ``jnp.sum`` reduces over the GLOBAL array, so per-shard
+    fills never masquerade as the whole ring's (the async replay-fill
+    gauge; scalar rings divide by their scalar capacity)."""
+    import numpy as np
+
+    capacity = jax.tree_util.tree_leaves(buf.data)[0].shape[
+        1 if jnp.ndim(buf.size) >= 1 else 0]
+    rows = max(1, int(np.prod(jnp.shape(buf.size)) or 1))
+    denom = rows * int(capacity)
+    return float(jnp.sum(buf.size)) / denom if denom else 0.0
 
 
 def buffer_sample(buf: ReplayBuffer, key, batch_size: int) -> Any:

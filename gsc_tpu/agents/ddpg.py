@@ -38,7 +38,7 @@ f32, so the reward scale and tau=1e-4 target updates are unaffected.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,7 +119,7 @@ class DDPG:
                 self, cls.rollout_episode, static_argnums=(0, 8),
                 donate_argnums=(2, 3))
             self.learn_burst = donated_jit(
-                self, cls.learn_burst, static_argnums=(0,),
+                self, cls.learn_burst, static_argnums=(0, 3),
                 donate_argnums=(1,))
             self.episode_step = donated_jit(
                 self, cls.episode_step, static_argnums=(0, 8, 9),
@@ -386,12 +386,19 @@ class DDPG:
                 grads={"actor": agrad, "critic": cgrad})
         return state, metrics
 
-    def _learn_burst(self, state: DDPGState, sample_fn, constrain=None
+    def _learn_burst(self, state: DDPGState, sample_fn, constrain=None,
+                     steps: Optional[int] = None
                      ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
         """End-of-episode training: episode_steps gradient steps
         (simple_ddpg.py:307-325) as one fori_loop.  ``sample_fn(key)``
         yields a batch — single-buffer and cross-replica samplers both
         plug in here.
+
+        ``steps`` overrides the per-burst gradient-step count (static —
+        each distinct value is its own trace).  The async learner runs
+        bursts against an EXTERNALLY-advancing replay (actors keep
+        ingesting between bursts), where burst length is a pacing knob
+        decoupled from the episode length the sync default encodes.
 
         ``constrain`` (optional; the sharded multi-chip path) re-pins the
         carried learner state — top of every gradient step AND the
@@ -440,8 +447,12 @@ class DDPG:
         if self.learn_ledger is not None:
             zero["learn_signal"] = zero_learn_signal(self.learn_ledger,
                                                      state)
-        n_steps = (self.agent.learn_steps if self.agent.learn_steps
-                   is not None else self.agent.episode_steps)
+        # `steps` is a STATIC jit arg (dp.py marks it static_argnums) —
+        # int() here normalizes a Python int, never syncs a tracer
+        n_steps = (int(steps) if steps is not None  # gsc-lint: disable=R1
+                   else self.agent.learn_steps
+                   if self.agent.learn_steps is not None
+                   else self.agent.episode_steps)
         state, metrics = jax.lax.fori_loop(0, n_steps, body, (state, zero))
         # divergence guardrail: flag the POST-update learner state in the
         # same device program (no extra host sync — the trainer reads it
@@ -449,8 +460,10 @@ class DDPG:
         metrics = {**metrics, "state_finite": all_finite(state)}
         return state.replace(rng=rng), metrics
 
-    @partial(jax.jit, static_argnums=0)
-    def learn_burst(self, state: DDPGState, buffer: ReplayBuffer
+    @partial(jax.jit, static_argnums=(0, 3))
+    def learn_burst(self, state: DDPGState, buffer: ReplayBuffer,
+                    steps: Optional[int] = None
                     ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
         return self._learn_burst(
-            state, lambda k: buffer_sample(buffer, k, self.agent.batch_size))
+            state, lambda k: buffer_sample(buffer, k, self.agent.batch_size),
+            steps=steps)

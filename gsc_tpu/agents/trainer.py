@@ -1312,6 +1312,306 @@ class Trainer:
         # greedy evaluation must never depend on this run's mesh carving
         return to_host(state, buffers)
 
+    def train_async(self, episodes: int, num_replicas: int,
+                    chunk: int = 50, actor_threads: int = 2,
+                    verbose: bool = False, device_traffic: bool = True,
+                    profile: bool = False,
+                    init_state: Optional[DDPGState] = None,
+                    init_buffers=None, start_episode: int = 0,
+                    ckpt_manager=None, ckpt_interval: int = 0,
+                    preempt=None, plan=None, publisher=None,
+                    publish_bursts: int = 1, curriculum=None,
+                    max_staleness: int = 0, learn_ratio: float = 1.0,
+                    throttle_s: float = 0.0):
+        """Decoupled actor/learner training (``cli train --async``):
+        ``actor_threads`` rollout threads run the jitted replica rollout
+        continuously and ship device-resident transition blocks into the
+        shared replay ring, while THIS thread — the learner — ingests
+        them via one jitted ``replay_ingest`` per block, runs learn
+        bursts back-to-back under its ``learn_ratio`` pacing, and
+        publishes actor weights every ``publish_bursts`` bursts through
+        a :class:`~gsc_tpu.serve.fleet.WeightPublisher` the actors
+        subscribe to in-process (see :mod:`gsc_tpu.parallel.async_rl`
+        for the full architecture + staleness-bounding contract).
+
+        Scenario production (scheduled topology + DeviceTraffic,
+        registry ``--topo-mix``, or the on-device factory with the TD
+        curriculum) matches :meth:`train_parallel` episode for episode —
+        scenarios are keyed by GLOBAL episode index, so what an episode
+        trains on does not depend on which actor thread ran it.
+
+        When sync still wins (documented limits, refused loudly):
+
+        - ``plan`` (``--mesh``) — the sharded dispatch's lazy jit build
+          and device-placement memos are not safe under concurrent actor
+          dispatch; the async path is single-mesh for now.
+        - ``--fault-plan`` — no injection sites or rollback guard here,
+          same refusal as train_parallel.
+        - Bit-exact learning curves vs the sync control — actors act on
+          K-burst-old weights by design; equivalence is BANDED
+          (bench_diff curve bands at matched env-step + gradient-step
+          budgets, tools/async_bench.py), never a digest.
+
+        ``throttle_s`` artificially delays each burst (test/chaos knob
+        for forcing backpressure); ``max_staleness`` bounds how many
+        produced-but-uningested env steps the actors may run ahead
+        (0 = one episode per actor).  Returns (state, buffers); the
+        run's measured accounting (learner idle fraction, policy-lag
+        extrema, produced==ingested proof) lands in
+        ``self.async_info``."""
+        if self.fault_plan is not None:
+            raise ValueError(
+                "--fault-plan is not supported on the async actor/learner "
+                "path (no injection sites or rollback guard); run the "
+                "chaos plan with --replicas 1")
+        if plan is not None:
+            raise ValueError(
+                "--async does not compose with --mesh yet: the sharded "
+                "dispatch builds its jits lazily and memoizes device "
+                "placements, neither of which is safe under concurrent "
+                "actor dispatch — run sharded training synchronously")
+        if profile and self.result_dir:
+            from ..utils.debug import Profiler
+            with Profiler(os.path.join(self.result_dir, "profile")):
+                return self.train_async(
+                    episodes, num_replicas, chunk,
+                    actor_threads=actor_threads, verbose=verbose,
+                    device_traffic=device_traffic, profile=False,
+                    init_state=init_state, init_buffers=init_buffers,
+                    start_episode=start_episode,
+                    ckpt_manager=ckpt_manager,
+                    ckpt_interval=ckpt_interval, preempt=preempt,
+                    publisher=publisher, publish_bursts=publish_bursts,
+                    curriculum=curriculum, max_staleness=max_staleness,
+                    learn_ratio=learn_ratio, throttle_s=throttle_s)
+        from ..parallel import ParallelDDPG
+        from ..parallel.async_rl import AsyncConfig, run_async
+        from ..sim.traffic_device import DeviceTraffic
+        from .buffer import buffer_fill_frac
+
+        steps_per_ep = self.agent_cfg.episode_steps
+        if steps_per_ep % chunk != 0:
+            raise ValueError(
+                f"chunk ({chunk}) must divide episode_steps "
+                f"({steps_per_ep})")
+        factory = (self.driver.scenario_factory
+                   if getattr(self.driver, "factory_spec", None)
+                   is not None else None)
+        if factory is not None and not device_traffic:
+            raise ValueError(
+                "the scenario factory IS on-device sampling — "
+                "device_traffic=False has no host path to fall back to "
+                "(use a registry --topo-mix for host-generated traffic)")
+        mix_plan = (self.driver.mix_plan(num_replicas)
+                    if getattr(self.driver, "topo_mix", None)
+                    and factory is None else None)
+        if mix_plan is not None:
+            from ..topology.scenarios import (mix_device_samplers,
+                                              sample_mix_device)
+        curr = None
+        if factory is not None:
+            from ..env.curriculum import Curriculum, CurriculumConfig
+            curr = Curriculum(factory.family_names,
+                              curriculum or CurriculumConfig())
+        # donate=False is load-bearing: actors hand their scratch blocks
+        # to the learner BY REFERENCE, so rollout outputs must be fresh
+        # arrays, never donated-in-place ones another thread still reads.
+        # The one donated call on this path is replay_ingest, whose ring
+        # the learner thread owns exclusively (async_rl module docs).
+        pddpg = ParallelDDPG(self.env, self.agent_cfg,
+                             num_replicas=num_replicas, donate=False,
+                             gnn_impl=self.ddpg.actor.gnn_impl,
+                             per_replica_topology=(mix_plan is not None
+                                                   or factory is not None),
+                             learn_ledger=self.ddpg.learn_ledger)
+        seg_names = (self.learn_obs.segment_names
+                     if self.learn_obs is not None else None)
+        base = jax.random.PRNGKey(self.seed)
+        # restored carries must be re-materialized before donation —
+        # replay_ingest donates the ring, and donating orbax-restored
+        # (host-owned / aliased) leaves aborts the process (see train())
+        if init_state is not None:
+            init_state = jax.tree_util.tree_map(jnp.copy, init_state)
+        if init_buffers is not None:
+            init_buffers = jax.tree_util.tree_map(jnp.copy, init_buffers)
+
+        topo0, traffic0 = self.driver.episode(0, False)
+        _, one_obs = self.env.reset(jax.random.fold_in(base, 1000), topo0,
+                                    traffic0)
+        state = init_state if init_state is not None else \
+            pddpg.init(jax.random.fold_in(base, 0), one_obs)
+        buffers = init_buffers if init_buffers is not None else \
+            pddpg.init_buffers(one_obs)
+
+        samplers = {}
+        mix_samplers = None
+
+        def episode_traffic(ep, topo):
+            nonlocal mix_samplers
+            if mix_plan is not None:
+                if not device_traffic:
+                    return self.driver.mix_traffic(ep, mix_plan)
+                if mix_samplers is None:
+                    mix_samplers = mix_device_samplers(
+                        mix_plan, self.env.sim_cfg, self.env.service,
+                        steps_per_ep, default_trace=self.driver.trace)
+                return sample_mix_device(
+                    mix_plan, mix_samplers,
+                    jax.random.fold_in(base, 2000 + ep))
+            if not device_traffic:
+                stacked = [self.driver.traffic_for(
+                    ep, topo, seed=self.driver.base_seed + 1000 * ep + r)
+                    for r in range(num_replicas)]
+                return jax.tree_util.tree_map(
+                    lambda *xs: jax.numpy.stack(xs), *stacked)
+            if id(topo) not in samplers:
+                samplers[id(topo)] = DeviceTraffic(
+                    self.env.sim_cfg, self.env.service, topo, steps_per_ep,
+                    trace=self.driver.trace, capacity=self.driver.capacity)
+            return samplers[id(topo)].sample_batch(
+                jax.random.fold_in(base, 2000 + ep), num_replicas)
+
+        def scenario_fn(ep):
+            # called from actor threads under async_rl's scenario lock;
+            # keyed by GLOBAL episode index exactly like train_parallel,
+            # so the scenario stream is thread-schedule-independent
+            with phase_span("scenario_regen", timer, hub):
+                if factory is not None:
+                    probs = jax.numpy.asarray(curr.weights(),
+                                              jax.numpy.float32)
+                    return factory.sample_batch(
+                        jax.random.fold_in(base, 2000 + ep), probs,
+                        num_replicas)
+                topo = (mix_plan.topo if mix_plan is not None
+                        else self.driver.topology_for(ep))
+                return topo, episode_traffic(ep, topo)
+
+        self.phase_timer = timer = PhaseTimer()
+        hub = self.obs.hub if self.obs else None
+        self.preempted = False
+        self._last_drained = start_episode - 1
+        if self.obs:
+            self.obs.resume_watchdog()
+
+        start = time.time()
+        drained_n = [0]
+
+        def on_episode(rec, ring):
+            """Learner-thread drain of one actor episode: the same
+            history/rewards/obs row discipline as train_parallel, in
+            COMPLETION order (the episode index rides on every row and
+            event, so analysis re-sorts; rewards.csv order is completion
+            order — documented in README)."""
+            ep = rec["episode"]
+            drained_n[0] += 1
+            sps = (drained_n[0] * steps_per_ep * num_replicas
+                   / (time.time() - start))
+            row = {"episodic_return": rec["episodic_return"],
+                   "mean_succ_ratio": rec["mean_succ_ratio"],
+                   "final_succ_ratio": rec["final_succ_ratio"],
+                   "episode": ep, "sps": sps}
+            self.history.append(row)
+            self.rewards_writer.write(rec["episodic_return"])
+            if self.tb:
+                gs = (ep + 1) * steps_per_ep
+                self.tb.add_scalar("charts/episodic_return",
+                                   rec["episodic_return"], gs)
+                self.tb.add_scalar("charts/SPS", sps, gs)
+            if verbose:
+                log.info("episode=%d actor=%d v=%d return=%.3f sps=%.1f",
+                         ep, rec["actor"], rec["policy_version"],
+                         rec["episodic_return"], sps)
+            if curr is not None:
+                curr.emit_weights(hub, ep)
+            if self.obs:
+                extra = {"replicas": num_replicas,
+                         "actor": rec["actor"],
+                         "policy_version": rec["policy_version"]}
+                if mix_plan is None and factory is None:
+                    extra = self._topology_extra(
+                        ep, rec["episodic_return"], extra=extra)
+                self.obs.episode_dispatched(ep)
+                self.obs.episode_end(
+                    episode=ep,
+                    global_step=(ep + 1) * steps_per_ep - 1,
+                    metrics={k: v for k, v in row.items()
+                             if k not in ("episode", "sps")},
+                    sps=sps, phases=timer.summary(),
+                    replay_bytes=buffer_nbytes(ring), extra=extra)
+            if hub is not None:
+                # global ring fill (one [B]-vector sync per drained
+                # episode — the satellite gauge that stays correct when
+                # the ring lives sharded)
+                hub.gauge("replay_fill_frac", buffer_fill_frac(ring))
+            self._last_drained = max(self._last_drained, ep)
+
+        def on_burst(n, st, metrics):
+            if curr is None:
+                return
+            sig = (metrics or {}).get("learn_signal") \
+                if isinstance(metrics, dict) else None
+            if sig is not None:
+                # one [K]-vector sync per burst (K = family count):
+                # the curriculum steers from LIVE burst TD here because
+                # async bursts are not tied to any episode's drain
+                curr.fold_td(np.asarray(sig["td_abs_sum"]),
+                             np.asarray(sig["td_count"]))
+
+        def checkpoint_fn(st, ring, n_drained):
+            # same finite-verified host-layout save as train_parallel
+            # (no rollback guard on this path either)
+            if self._finite_host(st):
+                ckpt_manager.save(st, jax.device_get(ring),
+                                  episode=self._last_drained + 1)
+            else:
+                self._recover(
+                    self._last_drained, site="learner_state",
+                    action="detected", fault="non_finite_state",
+                    detail="async path has no rollback guard — "
+                           "checkpoint skipped so the last-good pointer "
+                           "keeps the previous verified state")
+
+        cfg = AsyncConfig(actor_threads=actor_threads,
+                          publish_bursts=publish_bursts,
+                          max_staleness=max_staleness,
+                          learn_ratio=learn_ratio, throttle_s=throttle_s)
+        try:
+            res = run_async(
+                pddpg, scenario_fn, state, buffers, episodes,
+                steps_per_ep, chunk, self.seed, cfg,
+                publisher=publisher, hub=hub, timer=timer,
+                on_episode=on_episode, on_burst=on_burst,
+                should_stop=(
+                    (lambda: preempt.triggered) if preempt is not None
+                    else None),
+                start_episode=start_episode,
+                checkpoint_every=(ckpt_interval if ckpt_manager
+                                  is not None else 0),
+                checkpoint_fn=(checkpoint_fn if ckpt_manager is not None
+                               else None))
+        finally:
+            if self.obs:
+                self.obs.pause_watchdog()
+        if preempt is not None and preempt.triggered:
+            self.preempted = True
+            self._recover(
+                self._last_drained + 1, site="run",
+                action="preempt_snapshot", fault=preempt.signame,
+                detail="async run drained and stopped; the caller "
+                       "checkpoints the drained state")
+        self.completed_episodes = self._last_drained + 1
+        self.async_info = res.info
+        if hub is not None:
+            hub.event("async_train", **res.info)
+        # phases-only merge (primary=None): the async ledger splits the
+        # wall per entry (actor_dispatch / learn_dispatch / replay_ingest)
+        # and no single fused program owns a "dispatch" phase to attribute
+        self._note_cost_timings(timer, None)
+        self.rewards_writer.close()
+        if self.tb:
+            self.tb.close()
+        return res.state, res.buffers
+
     def evaluate(self, state: DDPGState, episodes: int = 1,
                  test_mode: bool = True, telemetry: bool = False,
                  write_schedule: bool = False,

@@ -75,7 +75,7 @@ DONATED_SIGS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...],
                               Tuple[int, ...]]] = {
     "episode_step": ((0, 1, 2), ("state", "buffer", "env_state"), (7, 8)),
     "rollout_episode": ((1, 2), ("buffer", "env_state"), (7,)),
-    "learn_burst": ((0,), ("state",), ()),
+    "learn_burst": ((0,), ("state",), (2,)),
     "chunk_step": ((0, 1), ("state", "buffers"), (7, 8)),
     "rollout_episodes": ((1,), ("buffers",), (7,)),
 }

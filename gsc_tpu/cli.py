@@ -435,6 +435,41 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
 @click.option("--publish-interval", default=1, show_default=True,
               help="episodes between hot-swap weight publishes "
                    "(with --hot-swap-dir)")
+@click.option("--async", "async_mode", is_flag=True, default=False,
+              help="decoupled actor/learner training (--replicas > 1): "
+                   "--async-actors rollout threads run the jitted replica "
+                   "rollout continuously and ship device-resident "
+                   "transition blocks into the shared replay ring (one "
+                   "jitted replay_ingest per block, no host round-trip), "
+                   "while the learner runs learn bursts back-to-back and "
+                   "publishes actor weights every --publish-bursts bursts "
+                   "over an in-process WeightPublisher bus the actors "
+                   "adopt between dispatches.  Off-policy staleness is "
+                   "bounded (--max-staleness) and measured (policy_lag / "
+                   "replay_lag gauges, actor_idle/learner_idle phases).  "
+                   "Does not compose with --mesh or --fault-plan yet; "
+                   "learning curves match the sync control within "
+                   "bench_diff's curve bands, not bit-exactly")
+@click.option("--async-actors", default=2, show_default=True,
+              help="rollout threads for --async (each owns its own env "
+                   "replicas batch, PRNG stream and adopted weights; "
+                   "episodes are round-robined by global index, so the "
+                   "scenario stream is thread-count-independent)")
+@click.option("--max-staleness", default=0, show_default=True,
+              help="--async backpressure bound: max produced-but-"
+                   "uningested env steps the actors may run ahead of the "
+                   "learner before the replay channel blocks them "
+                   "(0 = two episodes' worth per actor)")
+@click.option("--publish-bursts", default=1, show_default=True,
+              help="learn bursts between actor-weight publishes on the "
+                   "--async path (higher = staler actors, fewer "
+                   "publish-time host syncs)")
+@click.option("--learn-ratio", default=1.0, show_default=True,
+              help="--async learner pacing: gradient-step budget per "
+                   "ingested env step, relative to the sync control "
+                   "(1.0 = one burst per replicas*episode_steps ingested "
+                   "steps — the matched-budget setting the curve bands "
+                   "assume)")
 @click.option("--curriculum-temperature", default=_CURRICULUM_DEFAULTS[0],
               show_default=True,
               help="TD auto-curriculum softmax temperature over the "
@@ -459,7 +494,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           obs_rotate_mb, perf_enabled, learnobs_enabled, metrics_port,
           watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
-          ckpt_retain, hot_swap_dir, publish_interval,
+          ckpt_retain, hot_swap_dir, publish_interval, async_mode,
+          async_actors, max_staleness, publish_bursts, learn_ratio,
           curriculum_temperature, curriculum_floor, jax_cache_dir,
           verbose):
     """Train DDPG, checkpoint, then one greedy test episode
@@ -496,6 +532,40 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
         raise click.BadParameter("--unroll must be a positive integer")
     if publish_interval < 1:
         raise click.BadParameter("--publish-interval must be >= 1")
+    if async_mode:
+        # fail fast with the flag's name — the trainer raises the same
+        # refusals, but from deep inside the run loop after the build
+        if replicas <= 1:
+            raise click.BadParameter(
+                "--async decouples the replica rollout from the learner "
+                "— it requires the replica-parallel path (--replicas > 1)")
+        if mesh:
+            raise click.BadParameter(
+                "--async does not compose with --mesh yet: the sharded "
+                "dispatch builds its jits lazily and memoizes device "
+                "placements, which the actor threads would race — drop "
+                "one of the two flags")
+        if fault_plan:
+            raise click.BadParameter(
+                "--async does not compose with --fault-plan yet: fault "
+                "injection assumes the synchronous episode loop's "
+                "dispatch points")
+        if async_actors < 1:
+            raise click.BadParameter("--async-actors must be >= 1")
+        if max_staleness < 0:
+            raise click.BadParameter(
+                "--max-staleness must be >= 0 (0 = two episodes' worth "
+                "of steps per actor)")
+        if publish_bursts < 1:
+            raise click.BadParameter("--publish-bursts must be >= 1")
+        if learn_ratio <= 0:
+            raise click.BadParameter("--learn-ratio must be > 0")
+    elif (async_actors, max_staleness, publish_bursts, learn_ratio) != \
+            (2, 0, 1, 1.0):
+        raise click.BadParameter(
+            "--async-actors/--max-staleness/--publish-bursts/"
+            "--learn-ratio tune the decoupled actor/learner path — pass "
+            "--async or drop the flags")
     plan = None
     if mesh:
         # build the plan BEFORE any other jax work so the mesh binds the
@@ -687,6 +757,12 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                             "result_dir": rdir,
                             "ckpt_interval": ckpt_interval,
                             "hot_swap_dir": hot_swap_dir,
+                            **({"async": {
+                                "actors": async_actors,
+                                "max_staleness": max_staleness,
+                                "publish_bursts": publish_bursts,
+                                "learn_ratio": learn_ratio}}
+                               if async_mode else {}),
                             "jax_cache_dir": jax_cache_dir,
                             **mesh_meta,
                             **({"fault_plan": fplan.summary()} if fplan
@@ -758,7 +834,20 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                     publisher = WeightPublisher(
                         hot_swap_dir,
                         hub=(obs.hub if obs is not None else None))
-                if replicas > 1:
+                if replicas > 1 and async_mode:
+                    state, buffer = trainer.train_async(
+                        episodes, num_replicas=replicas, chunk=chunk,
+                        actor_threads=async_actors,
+                        verbose=verbose, profile=profile,
+                        init_state=init_state, init_buffers=init_buffer,
+                        start_episode=start_episode,
+                        ckpt_manager=manager, ckpt_interval=ckpt_interval,
+                        preempt=guard, publisher=publisher,
+                        publish_bursts=publish_bursts,
+                        curriculum=curriculum_cfg,
+                        max_staleness=max_staleness,
+                        learn_ratio=learn_ratio)
+                elif replicas > 1:
                     state, buffer = trainer.train_parallel(
                         episodes, num_replicas=replicas, chunk=chunk,
                         verbose=verbose, profile=profile,

@@ -20,7 +20,11 @@ from .watchdog import PipelineWatchdog
 log = logging.getLogger("gsc_tpu.obs.run")
 
 # phases whose per-episode wall deltas are worth percentile tracking
-_PHASE_HIST = ("host_sample", "host_sample_wait", "dispatch", "drain")
+# (the last four are the async actor/learner ledger: actor-side rollout
+# dispatch + backpressure wait, learner-side ingest + data wait)
+_PHASE_HIST = ("host_sample", "host_sample_wait", "dispatch", "drain",
+               "actor_dispatch", "actor_idle", "replay_ingest",
+               "learner_idle")
 
 
 class RunObserver:

@@ -1,0 +1,524 @@
+"""Decoupled actor/learner training — the Sebulba shape (arXiv:2104.06272).
+
+``Trainer.train_parallel`` interleaves acting and learning on ONE dispatch
+path: the learner idles while rollouts run and vice versa.  This module
+splits them:
+
+- **Actor threads** run the jitted replica rollout continuously: each
+  actor owns its own env replicas, PRNG stream and a small per-dispatch
+  SCRATCH ring (capacity = one chunk), and ships every finished chunk's
+  transition block — device-resident ``[B, chunk, ...]`` leaves, never a
+  host copy — into the replay channel.  Between rollout dispatches the
+  actor adopts newly published weights through an in-process
+  :class:`~gsc_tpu.serve.fleet.VersionWatcher` (same between-dispatch
+  swap discipline as the serving fleet: no batch ever mixes policy
+  versions, because adoption only happens at chunk boundaries in the
+  actor's own thread).
+
+- The **learner loop** (the calling thread) owns the shared ``[B, cap]``
+  replay ring: it folds queued transition blocks in via one jitted
+  ``replay_ingest`` call per block (a donated in-place scatter — the
+  MindSpeed-RL-style device-resident replay service; transition tensors
+  never round-trip through the host on the steady path), runs
+  ``learn_burst``s back-to-back on the freshest buffer state whenever its
+  update budget allows, and publishes actor weights every
+  ``publish_bursts`` bursts through the :class:`WeightPublisher` bus.
+
+Off-policy staleness is the risk, so it is BOUNDED and MEASURED instead
+of assumed away: ``max_staleness`` caps how many produced-but-uningested
+env steps the actors may run ahead (the channel blocks the producer —
+backpressure — and the wait is the ``actor_idle`` phase), the
+``policy_lag`` gauge records how many published versions behind each
+ingested block's acting policy was, and ``replay_lag`` gauges the
+outstanding-step backlog at every ingest.  ``learn_ratio`` paces the
+learner's update budget against ingested env steps (1.0 = the sync
+control's one burst per B*episode_steps steps, so learning curves are
+compared at matched gradient-step budgets); while the budget is unspent
+the bursts dispatch back-to-back, and waiting for acting to unlock the
+next burst is the ``learner_idle`` phase the ASYNC bench bounds.
+
+Donation discipline across threads: the ParallelDDPG here must be built
+with ``donate=False`` — actors hand their scratch blocks to the learner
+by reference, so a donating rollout would consume buffers another thread
+still reads.  The ONLY donated call is ``replay_ingest`` on the shared
+ring, which exactly one thread (the learner) owns and always rebinds.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import lru_cache, partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..agents.buffer import ReplayBuffer
+
+log = logging.getLogger("gsc_tpu.parallel.async_rl")
+
+
+@lru_cache(maxsize=None)
+def make_replay_ingest(num_replicas: int, capacity: int):
+    """The jitted replay service insert: fold one ``[B, T, ...]``
+    transition block (an actor's scratch ring in insertion order) into
+    the shared ``[B, cap, ...]`` ring at each replica's write cursor.
+
+    The ring is DONATED — XLA scatters the block into the multi-MB replay
+    in place instead of copying it per ingest — so the caller must own
+    the ring exclusively and always rebind from the return (the learner
+    loop does).  ``T`` is static (the actors' chunk size), so the whole
+    async interleaving runs through ONE trace of this function.
+    Memoized by ``(B, cap)``: a warmup ``run_async`` followed by a
+    measured one (the bench split) reuses the SAME jit — the steady
+    window stays zero-retrace across calls."""
+    B = int(num_replicas)
+    rows = jnp.arange(B)[:, None]
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def replay_ingest(buffers: ReplayBuffer, block: Any) -> ReplayBuffer:
+        T = jax.tree_util.tree_leaves(block)[0].shape[1]
+        # per-replica wrapped slot indices [B, T] from the write cursor
+        idx = (buffers.pos[:, None] + jnp.arange(T)[None, :]) % capacity
+        data = jax.tree_util.tree_map(
+            lambda d, s: d.at[rows, idx].set(s.astype(d.dtype)),
+            buffers.data, block)
+        return buffers.replace(
+            data=data, pos=(buffers.pos + T) % capacity,
+            size=jnp.minimum(buffers.size + T, capacity))
+
+    return replay_ingest
+
+
+def _finite_host(tree) -> bool:
+    """Host-side all-finite verdict (syncs the tree — publish cadence
+    only, same discipline as train_parallel's pre-publish gate)."""
+    return all(bool(np.isfinite(np.asarray(l)).all())
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+@dataclass
+class AsyncConfig:
+    """Knobs for the decoupled actor/learner loop."""
+
+    actor_threads: int = 2
+    # learner->actor weight publish cadence, in learn bursts
+    publish_bursts: int = 1
+    # max produced-but-uningested env steps the actors may run ahead of
+    # the learner (the off-policy staleness bound; the channel BLOCKS the
+    # producer past it).  0 = two full episodes per actor, the default
+    # that keeps a slow learner from unbounded off-policy drift without
+    # throttling a healthy fleet (one episode being acted plus one queued
+    # behind the learner's ingest dispatch).
+    max_staleness: int = 0
+    # learner update budget per ingested env step, relative to the sync
+    # control (1.0 = one burst per B*episode_steps ingested steps — the
+    # matched-gradient-budget setting the curve-equivalence bands assume)
+    learn_ratio: float = 1.0
+    # test hook: artificial per-burst learner delay (the staleness-bound
+    # tests slow the learner down to force backpressure); 0 in production
+    throttle_s: float = 0.0
+    # seconds the learner waits per idle poll (granularity of the
+    # learner_idle phase, not a rate limit)
+    idle_wait_s: float = 0.002
+
+
+class _Channel:
+    """Bounded actor->learner conduit of device-resident transition
+    blocks.  ``put`` blocks while the outstanding (produced - ingested)
+    step backlog would exceed ``max_outstanding`` — that wait IS the
+    staleness backpressure."""
+
+    def __init__(self, max_outstanding: int):
+        self.max_outstanding = int(max_outstanding)
+        self._cond = threading.Condition()
+        self._blocks: deque = deque()
+        self.produced_steps = 0
+        self.ingested_steps = 0
+        self.max_observed_lag = 0
+        self._stop = False
+
+    def outstanding(self) -> int:
+        return self.produced_steps - self.ingested_steps
+
+    def put(self, block, steps: int, version: int, timer=None) -> bool:
+        """Enqueue one block; returns False when the run is stopping."""
+        with self._cond:
+            while (not self._stop and self._blocks
+                   and self.outstanding() + steps > self.max_outstanding):
+                t0 = time.perf_counter()
+                self._cond.wait(0.05)
+                if timer is not None:
+                    timer.add("actor_idle", time.perf_counter() - t0)
+            if self._stop:
+                return False
+            self._blocks.append((block, int(steps), int(version)))
+            self.produced_steps += int(steps)
+            self.max_observed_lag = max(self.max_observed_lag,
+                                        self.outstanding())
+            self._cond.notify_all()
+            return True
+
+    def get_nowait(self):
+        with self._cond:
+            if not self._blocks:
+                return None
+            item = self._blocks.popleft()
+            self.ingested_steps += item[1]
+            self._cond.notify_all()
+            return item
+
+    def wait_for_data(self, timeout: float):
+        with self._cond:
+            if not self._blocks:
+                self._cond.wait(timeout)
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+
+class _ActorPolicy:
+    """In-process 'server' end of the VersionWatcher protocol for one
+    actor: ``apply_weights`` runs IN the actor's own thread (poll_once is
+    called between rollout dispatches), so the adopted params can never
+    reach a batch mid-flight — the actor-side analogue of the fleet's
+    flush-lock discipline."""
+
+    def __init__(self, treedef):
+        self.treedef = treedef
+        self.policy_version = 0
+        self.params = None
+
+    def apply_weights(self, leaves, version: int, fingerprint,
+                      meta: Optional[Dict] = None):
+        self.params = jax.tree_util.tree_unflatten(self.treedef,
+                                                   list(leaves))
+        self.policy_version = int(version)
+
+
+@dataclass
+class AsyncResult:
+    """What one decoupled run produced, for the caller's bookkeeping."""
+
+    state: Any
+    buffers: Any
+    episodes: List[Dict] = field(default_factory=list)   # completion order
+    info: Dict = field(default_factory=dict)
+
+
+def run_async(pddpg, scenario_fn: Callable, state, buffers,
+              episodes: int, episode_steps: int, chunk: int, seed: int,
+              cfg: AsyncConfig, publisher=None, hub=None, timer=None,
+              on_episode: Optional[Callable] = None,
+              on_burst: Optional[Callable] = None,
+              should_stop: Optional[Callable] = None,
+              start_episode: int = 0, checkpoint_every: int = 0,
+              checkpoint_fn: Optional[Callable] = None) -> AsyncResult:
+    """Drive ``episodes - start_episode`` episodes through
+    ``cfg.actor_threads`` rollout threads feeding the learner loop (the
+    calling thread).  ``scenario_fn(ep) -> (topo, traffic)`` supplies
+    episode ``ep``'s scenario (called from actor threads under one shared
+    lock — host scenario production stays serialized and
+    episode-deterministic).  ``on_episode(record, buffers)`` fires in
+    the LEARNER thread as each actor episode's stats drain (record
+    carries episode / return / succ ratios / policy_version / actor;
+    buffers is the live ring, for fill/bytes gauges).  ``on_burst(n,
+    state, metrics)`` fires after each learn burst (metrics are live
+    device values — callers must not sync them in the hot loop).
+    ``should_stop()`` polled at episode boundaries (preemption).
+    ``checkpoint_fn(state, buffers, episodes_drained)`` fires in the
+    learner thread every ``checkpoint_every`` drained episodes — the
+    only thread that owns the carries, so a save can never race a
+    rebind.
+
+    Returns an :class:`AsyncResult`; ``info`` carries the drain-proved
+    accounting: produced == ingested steps (no transition lost), the
+    learner idle fraction, burst count, publish count and the observed
+    policy/replay lag extrema."""
+    from ..serve.fleet import VersionWatcher, WeightPublisher
+
+    B = pddpg.B
+    if episode_steps % chunk != 0:
+        raise ValueError(f"chunk ({chunk}) must divide episode_steps "
+                         f"({episode_steps})")
+    cap = int(jax.tree_util.tree_leaves(buffers.data)[0].shape[1])
+    if cap < chunk:
+        raise ValueError(
+            f"replay capacity per replica ({cap}) must be >= chunk "
+            f"({chunk}) — a single ingest would wrap past itself")
+    n_actors = max(1, int(cfg.actor_threads))
+    total_eps = episodes - start_episode
+    if total_eps <= 0:
+        return AsyncResult(state=state, buffers=buffers)
+    # default backlog cap: TWO episodes' worth of steps per actor — one
+    # being acted plus one queued behind the learner's ingest dispatch
+    # (which can wait on the ring's in-flight burst readers); a
+    # one-episode cap throttles a healthy fleet into device bubbles
+    # while the policy-version lag stays burst-paced (~1-2) either way
+    max_stale = (int(cfg.max_staleness) if cfg.max_staleness > 0
+                 else 2 * n_actors * B * episode_steps)
+    channel = _Channel(max_stale)
+    results: deque = deque()
+    results_lock = threading.Lock()
+    stop_event = threading.Event()
+    actor_errors: List[BaseException] = []
+    # the actors' first dispatches serialize under this lock so each
+    # entry point traces exactly once (two threads racing an empty jit
+    # cache would both trace — the zero-retrace contract forbids that)
+    compile_lock = threading.Lock()
+    scenario_lock = threading.Lock()
+
+    if publisher is None:
+        publisher = WeightPublisher(hub=hub)   # in-process channel only
+    replay_ingest = make_replay_ingest(B, cap)
+    treedef = jax.tree_util.tree_structure(state.actor_params)
+    base = jax.random.PRNGKey(seed)
+
+    # episode ownership: actor a runs global episodes start+a, start+a+A,
+    # ... — deterministic regardless of thread scheduling
+    def actor_episodes(aid):
+        return range(start_episode + aid, episodes, n_actors)
+
+    policy_lags: List[int] = []
+
+    def actor_loop(aid: int):
+        policy = _ActorPolicy(treedef)
+        watcher = VersionWatcher(None, policy, hub=hub,
+                                 publisher=publisher)
+        # every actor starts from the published-or-initial params with
+        # its OWN rng stream (identical streams would collapse the
+        # exploration the replica axis exists to diversify)
+        a_state = state.replace(rng=jax.random.fold_in(state.rng,
+                                                       1000 + aid))
+        first = True
+        n_chunks = episode_steps // chunk
+        try:
+            for ep in actor_episodes(aid):
+                if stop_event.is_set():
+                    return
+                with scenario_lock:
+                    topo, traffic = scenario_fn(ep)
+                lock = compile_lock if first else None
+                if lock is not None:
+                    lock.acquire()
+                try:
+                    env_states, obs = pddpg.reset_all(
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(seed + ep + 2), 0),
+                        topo, traffic)
+                    if first:
+                        one_obs = jax.tree_util.tree_map(
+                            lambda x: x[0], obs)
+                        scratch = pddpg.init_buffers(one_obs,
+                                                     capacity=chunk)
+                    chunk_stats = []
+                    for c in range(n_chunks):
+                        # between-dispatch weight adoption: poll_once
+                        # runs HERE, in the actor's own thread, so a
+                        # swap can never land mid-batch (the fleet's
+                        # flush-lock discipline, by construction)
+                        if watcher.poll_once():
+                            a_state = a_state.replace(
+                                actor_params=policy.params)
+                        start = jnp.int32(ep * episode_steps + c * chunk)
+                        with (timer.phase("actor_dispatch") if timer
+                              else _noop()):
+                            (a_state, scratch, env_states, obs,
+                             stats) = pddpg.rollout_episodes(
+                                a_state, scratch, env_states, obs,
+                                topo, traffic, start, chunk)
+                        chunk_stats.append(stats)
+                        if not channel.put(scratch.data, B * chunk,
+                                           policy.policy_version,
+                                           timer=timer):
+                            return
+                finally:
+                    if lock is not None:
+                        lock.release()
+                        first = False
+                with results_lock:
+                    results.append({"episode": ep, "actor": aid,
+                                    "policy_version":
+                                        policy.policy_version,
+                                    "chunk_stats": chunk_stats})
+        except BaseException as e:   # surfaced by the learner loop
+            actor_errors.append(e)
+            log.exception("actor %d died", aid)
+        finally:
+            watcher.stop()   # drops the publisher subscription; an
+            # externally-owned publisher must not keep dead inboxes fed
+
+    threads = [threading.Thread(target=actor_loop, args=(a,),
+                                name=f"gsc-actor-{a}", daemon=True)
+               for a in range(n_actors)]
+    steps_per_burst = B * episode_steps   # the sync control's cadence
+    bursts = publishes = last_ckpt = 0
+    drained: List[Dict] = []
+    last_metrics = None
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    def allowance() -> int:
+        return int(channel.ingested_steps * cfg.learn_ratio
+                   // steps_per_burst)
+
+    def maybe_publish(force: bool = False):
+        nonlocal publishes
+        if not force and (cfg.publish_bursts <= 0
+                          or bursts % cfg.publish_bursts != 0):
+            return
+        params = state.actor_params
+        if _finite_host(params):
+            publisher.publish(params, meta={"burst": bursts,
+                                            "episodes": len(drained)})
+            publishes += 1
+        else:
+            log.warning("non-finite actor params at burst %d — publish "
+                        "skipped so a poisoned state never reaches the "
+                        "actors", bursts)
+            if hub is not None:
+                hub.counter("async_publish_skipped_total")
+
+    def check_stop():
+        # polled at EVERY progress point, not just the outer loop top: a
+        # fast actor fleet can finish the whole run inside one inner
+        # ingest/drain pass, and a stop that only lands between passes
+        # would never actually stop anything
+        if should_stop is not None and not stop_event.is_set() \
+                and should_stop():
+            stop_event.set()   # actors stop at the next boundary; the
+            # learner still DRAINS everything already produced
+
+    def drain_results():
+        while True:
+            check_stop()
+            with results_lock:
+                if not results:
+                    return
+                rec = results.popleft()
+            stats = rec.pop("chunk_stats")
+            # device scalars, synced HERE (learner thread) so actors
+            # never block on a host round-trip
+            rec["episodic_return"] = sum(
+                float(s["episodic_return"]) for s in stats)
+            rec["mean_succ_ratio"] = (sum(
+                float(s["mean_succ_ratio"]) for s in stats) / len(stats))
+            rec["final_succ_ratio"] = float(
+                stats[-1]["final_succ_ratio"])
+            flags = [float(s["state_finite"]) for s in stats
+                     if "state_finite" in s]
+            rec["state_finite"] = bool(min(flags) > 0) if flags else None
+            drained.append(rec)
+            if on_episode is not None:
+                on_episode(rec, buffers)
+
+    actors_alive = lambda: any(t.is_alive() for t in threads)  # noqa: E731
+    try:
+        while True:
+            if actor_errors:
+                stop_event.set()
+                channel.stop()
+                raise RuntimeError(
+                    "async actor thread died") from actor_errors[0]
+            check_stop()
+            progressed = False
+            # pop EVERYTHING queued before dispatching a single ingest:
+            # the pop is what releases the staleness backpressure, and an
+            # ingest dispatch can block on the ring's pending readers
+            # (donating the ring while the in-flight learn_burst still
+            # samples it makes the runtime wait for the burst) — popping
+            # first keeps the actors dispatching through that wait
+            # instead of stalling the whole fleet one pop per blocked
+            # dispatch
+            items = []
+            item = channel.get_nowait()
+            while item is not None:
+                items.append(item)
+                item = channel.get_nowait()
+            for block, steps, version in items:
+                with (timer.phase("replay_ingest") if timer
+                      else _noop()):
+                    buffers = replay_ingest(buffers, block)
+                lag = publisher.version - version
+                policy_lags.append(lag)
+                if hub is not None:
+                    hub.gauge("policy_lag", lag)
+                    hub.gauge("replay_lag", channel.outstanding())
+                progressed = True
+                check_stop()
+            drain_results()
+            if (checkpoint_every and checkpoint_fn is not None
+                    and len(drained) - last_ckpt >= checkpoint_every):
+                last_ckpt = len(drained)
+                checkpoint_fn(state, buffers, len(drained))
+            if bursts < allowance():
+                with (timer.phase("learn_dispatch") if timer
+                      else _noop()):
+                    state, last_metrics = pddpg.learn_burst(state,
+                                                            buffers)
+                bursts += 1
+                if cfg.throttle_s:
+                    time.sleep(cfg.throttle_s)
+                if on_burst is not None:
+                    on_burst(bursts, state, last_metrics)
+                maybe_publish()
+                progressed = True
+            if not progressed:
+                if not actors_alive() and channel.outstanding() == 0:
+                    break
+                t0 = time.perf_counter()
+                channel.wait_for_data(cfg.idle_wait_s)
+                if timer is not None:
+                    timer.add("learner_idle", time.perf_counter() - t0)
+    finally:
+        stop_event.set()
+        channel.stop()
+        for t in threads:
+            t.join(timeout=30.0)
+    drain_results()
+    # graceful drain: nothing in flight, nothing lost, no future hung
+    jax.block_until_ready((state, buffers))
+    wall = time.perf_counter() - t_start
+    idle_s = 0.0
+    if timer is not None:
+        idle_s = (timer.summary().get("learner_idle")
+                  or {}).get("total_s", 0.0)
+    info = {
+        "actors": n_actors,
+        "episodes_drained": len(drained),
+        "produced_steps": channel.produced_steps,
+        "ingested_steps": channel.ingested_steps,
+        "transitions_lost": (channel.produced_steps
+                             - channel.ingested_steps),
+        "bursts": bursts,
+        "publishes": publishes,
+        "published_version": publisher.version,
+        "max_staleness": max_stale,
+        "max_replay_lag": channel.max_observed_lag,
+        "policy_lag_max": max(policy_lags) if policy_lags else 0,
+        "policy_lag_mean": (round(float(np.mean(policy_lags)), 4)
+                            if policy_lags else 0.0),
+        "wall_s": round(wall, 4),
+        "learner_idle_s": round(idle_s, 4),
+        "learner_idle_frac": round(idle_s / wall, 4) if wall > 0 else 0.0,
+    }
+    if hub is not None:
+        hub.gauge("learner_idle_frac", info["learner_idle_frac"])
+        hub.gauge("actor_policy_version", publisher.version)
+    return AsyncResult(state=state, buffers=buffers,
+                       episodes=drained, info=info)
+
+
+class _noop:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

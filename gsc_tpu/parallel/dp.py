@@ -94,7 +94,7 @@ class ParallelDDPG:
                 self, cls.rollout_episodes, static_argnums=(0, 8),
                 donate_argnums=(2,))
             self.learn_burst = donated_jit(
-                self, cls.learn_burst, static_argnums=(0,),
+                self, cls.learn_burst, static_argnums=(0, 3),
                 donate_argnums=(1,))
             self.chunk_step = donated_jit(
                 self, cls.chunk_step, static_argnums=(0, 8, 9),
@@ -180,7 +180,7 @@ class ParallelDDPG:
                 cls.rollout_episodes, (0, 8), (2,), 7,
                 (state_sh, data, data, data, rep))
             fns["learn_burst"] = shard_jit(
-                cls.learn_burst, (0,), (1,), 2, (state_sh, rep))
+                cls.learn_burst, (0, 3), (1,), 2, (state_sh, rep))
             return fns
 
         def state_in(state):
@@ -317,8 +317,8 @@ class ParallelDDPG:
         """Replicated learner state (init from a single-replica obs)."""
         return self.ddpg.init(rng, sample_obs)
 
-    def init_buffers(self, sample_obs,
-                     num_replicas: int = None) -> ReplayBuffer:
+    def init_buffers(self, sample_obs, num_replicas: int = None,
+                     capacity: int = None) -> ReplayBuffer:
         """Per-replica replay shards: leaves [B, capacity, ...]; capacity is
         mem_limit / B (floored at 1) so TOTAL memory matches the single-env
         agent's budget regardless of replica count — sampling is
@@ -329,8 +329,14 @@ class ParallelDDPG:
         the per-replica capacity) and converts it with
         ``host_local_array_to_global_array`` — materializing the global
         buffer on one device first would transiently hold process_count
-        times the per-chip replay budget."""
-        cap = max(self.agent.mem_limit // self.B, 1)
+        times the per-chip replay budget.
+
+        ``capacity`` overrides the per-replica slot count outright — the
+        async actors allocate chunk-sized SCRATCH rings this way (one
+        rollout dispatch fills the ring exactly, so the handed-off block
+        is the chunk's transitions in step order)."""
+        cap = (int(capacity) if capacity is not None
+               else max(self.agent.mem_limit // self.B, 1))
         b = self.B if num_replicas is None else num_replicas
         example = self.ddpg.example_transition(sample_obs)
         data = jax.tree_util.tree_map(
@@ -556,13 +562,16 @@ class ParallelDDPG:
             lambda d: d.reshape((self.B * b_per,) + d.shape[2:]), batch)
         return restore_batch(buffers.shapes, raw)
 
-    @partial(jax.jit, static_argnums=0)
-    def learn_burst(self, state: DDPGState, buffers: ReplayBuffer
+    @partial(jax.jit, static_argnums=(0, 3))
+    def learn_burst(self, state: DDPGState, buffers: ReplayBuffer,
+                    steps: int = None
                     ) -> Tuple[DDPGState, Dict[str, jnp.ndarray]]:
         """episode_steps gradient steps over the replica shards
-        (simple_ddpg.py:307-325 schedule), sampling per ``sample_mode``."""
+        (simple_ddpg.py:307-325 schedule), sampling per ``sample_mode``.
+        ``steps`` (static) overrides the burst length — the async
+        learner's pacing knob over its externally-advancing ring."""
         sampler = (self._sample_local if self.sample_mode == "local"
                    else self._sample_across)
         return self.ddpg._learn_burst(
             state, self._batch_sampler(sampler, buffers),
-            constrain=self._state_constraint())
+            constrain=self._state_constraint(), steps=steps)

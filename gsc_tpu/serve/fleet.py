@@ -98,14 +98,29 @@ class WeightPublisher:
     list); the leaves are flattened in ``jax.tree_util`` order, which is
     the order the watcher rebuilds them in — publisher and worker must
     agree on the tree structure (they do: both sides hold the same actor
-    params template)."""
+    params template).
 
-    def __init__(self, root: str, keep_versions: int = 8, hub=None,
-                 artifact_cache=None, artifact_keep: int = 8):
+    Two delivery channels, independently optional:
+
+    - ``root`` — the on-disk fleet protocol above, byte-identical
+      whether or not subscribers are also attached;
+    - ``subscribers`` — same-process callables ``fn(record, params)``
+      invoked after each publish with the ORIGINAL params pytree
+      (zero-copy: device arrays pass by reference, no npz, no
+      fingerprint sync).  ``root=None`` makes the publisher purely
+      in-process — the async actor/learner bus — where the record
+      carries ``fingerprint=None`` (hashing would force a device->host
+      sync per publish for a consumer that never validates bytes; the
+      in-process handoff cannot tear)."""
+
+    def __init__(self, root: Optional[str] = None, keep_versions: int = 8,
+                 hub=None, artifact_cache=None, artifact_keep: int = 8,
+                 subscribers: Sequence[Callable] = ()):
         if keep_versions < 1:
             raise ValueError(f"keep_versions must be >= 1: {keep_versions}")
-        self.root = os.path.abspath(root)
-        os.makedirs(self.root, exist_ok=True)
+        self.root = None if root is None else os.path.abspath(root)
+        if self.root is not None:
+            os.makedirs(self.root, exist_ok=True)
         self.keep_versions = int(keep_versions)
         self.hub = hub
         # the serving tier's compiled-policy cache (optional): pruned
@@ -113,7 +128,21 @@ class WeightPublisher:
         # accumulate one generation per published version
         self.artifact_cache = artifact_cache
         self.artifact_keep = int(artifact_keep)
-        self._version = self._scan_latest_version()
+        self.subscribers: List[Callable] = list(subscribers)
+        self._version = (self._scan_latest_version()
+                         if self.root is not None else 0)
+
+    def subscribe(self, fn: Callable) -> Callable:
+        """Attach an in-process ``fn(record, params)`` delivery target;
+        returns ``fn`` so watchers can hold it for unsubscribe."""
+        self.subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn: Callable):
+        try:
+            self.subscribers.remove(fn)
+        except ValueError:
+            pass
 
     def _scan_latest_version(self) -> int:
         latest = 0
@@ -130,40 +159,54 @@ class WeightPublisher:
 
     def publish(self, params, meta: Optional[Dict] = None) -> Dict:
         """Write the next version; returns the manifest record."""
-        leaves = self._flatten(params)
         version = self._version + 1
         name = _vname(version)
-        fingerprint = params_fingerprint(leaves)
-        blob_path = os.path.join(self.root, name + ".npz")
-        # atomic blob: npz to a temp file, then rename into place
-        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".npz.tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **{f"leaf_{i}": np.asarray(l)
-                               for i, l in enumerate(leaves)})
-            os.replace(tmp, blob_path)
-        except BaseException:
+        if self.root is None:
+            record = {
+                "format": WEIGHTS_FORMAT,
+                "version": version,
+                "fingerprint": None,
+                "blob": None,
+                "leaves": None,
+                "ts": round(time.time(), 3),
+                "meta": meta or {},
+            }
+        else:
+            leaves = self._flatten(params)
+            fingerprint = params_fingerprint(leaves)
+            blob_path = os.path.join(self.root, name + ".npz")
+            # atomic blob: npz to a temp file, then rename into place
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".npz.tmp")
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        record = {
-            "format": WEIGHTS_FORMAT,
-            "version": version,
-            "fingerprint": fingerprint,
-            "blob": os.path.basename(blob_path),
-            "leaves": _leaf_sig(leaves),
-            "ts": round(time.time(), 3),
-            "meta": meta or {},
-        }
-        from ..obs.sinks import write_atomic_json
-        write_atomic_json(os.path.join(self.root, name + ".json"), record)
-        # the pointer goes last: a watcher that reads it can always trust
-        # the blob+manifest it names are complete
-        write_atomic_json(os.path.join(self.root, "latest.json"), record)
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, **{f"leaf_{i}": np.asarray(l)
+                                   for i, l in enumerate(leaves)})
+                os.replace(tmp, blob_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            record = {
+                "format": WEIGHTS_FORMAT,
+                "version": version,
+                "fingerprint": fingerprint,
+                "blob": os.path.basename(blob_path),
+                "leaves": _leaf_sig(leaves),
+                "ts": round(time.time(), 3),
+                "meta": meta or {},
+            }
+            from ..obs.sinks import write_atomic_json
+            write_atomic_json(os.path.join(self.root, name + ".json"),
+                              record)
+            # the pointer goes last: a watcher that reads it can always
+            # trust the blob+manifest it names are complete
+            write_atomic_json(os.path.join(self.root, "latest.json"),
+                              record)
         self._version = version
-        self._prune_versions()
+        if self.root is not None:
+            self._prune_versions()
         if self.artifact_cache is not None:
             try:
                 self.artifact_cache.prune(keep_latest=self.artifact_keep)
@@ -171,9 +214,15 @@ class WeightPublisher:
                 log.warning("artifact-cache prune failed: %s", e)
         if self.hub is not None:
             self.hub.event("weight_publish", version=version,
-                           fingerprint=fingerprint,
+                           fingerprint=record["fingerprint"],
                            **({"meta": meta} if meta else {}))
             self.hub.gauge("serve_published_version", version)
+        for sub in list(self.subscribers):
+            try:   # a broken subscriber must not fail the fleet publish
+                sub(record, params)
+            except Exception:
+                log.exception("publish subscriber failed at version %d",
+                              version)
         return record
 
     @staticmethod
@@ -252,11 +301,31 @@ def load_version(root: str, record: Dict) -> List[np.ndarray]:
 
 class VersionWatcher:
     """Worker-side poller: swaps newly published versions into a running
-    :class:`~gsc_tpu.serve.server.PolicyServer` between dispatches."""
+    :class:`~gsc_tpu.serve.server.PolicyServer` between dispatches.
 
-    def __init__(self, root: str, server, poll_s: float = 0.2, hub=None,
-                 max_retries: int = 5):
-        self.root = os.path.abspath(root)
+    Two sources, mirroring the publisher's two channels:
+
+    - ``root`` — the on-disk protocol (poll ``latest.json``, load +
+      fingerprint-validate the blob);
+    - ``publisher`` — an in-process :class:`WeightPublisher` this
+      watcher subscribes to: each publish lands ``(record, params)`` in
+      a latest-wins inbox (delivery runs in the PUBLISHER's thread and
+      only stores a reference), and ``poll_once`` — still called by the
+      consumer's own thread, between its dispatches — adopts from the
+      inbox with no filesystem, no npz and no host copy.  The apply path
+      and swap discipline are identical either way."""
+
+    def __init__(self, root: Optional[str], server, poll_s: float = 0.2,
+                 hub=None, max_retries: int = 5, publisher=None):
+        if root is None and publisher is None:
+            raise ValueError("VersionWatcher needs a root directory or an "
+                             "in-process publisher")
+        self.root = None if root is None else os.path.abspath(root)
+        self.publisher = publisher
+        self._inbox: Optional[Tuple[Dict, object]] = None
+        self._inbox_lock = threading.Lock()
+        if publisher is not None:
+            self._subscription = publisher.subscribe(self._on_publish)
         self.server = server
         self.poll_s = float(poll_s)
         self.hub = hub
@@ -287,6 +356,8 @@ class VersionWatcher:
         thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=10.0)
+        if self.publisher is not None:
+            self.publisher.unsubscribe(self._subscription)
 
     def _run(self):
         while not self._stop_event.wait(self.poll_s):
@@ -295,16 +366,34 @@ class VersionWatcher:
             except Exception:   # a poll crash must not kill the thread
                 log.exception("version watcher poll failed")
 
+    def _on_publish(self, record: Dict, params):
+        """In-process delivery (runs in the publisher's thread): store a
+        reference, latest wins — adoption stays with poll_once in the
+        consumer's own thread."""
+        with self._inbox_lock:
+            self._inbox = (record, params)
+
     def poll_once(self) -> bool:
         """One poll; returns True iff a swap happened."""
-        rec = read_latest(self.root)
+        if self.publisher is not None:
+            with self._inbox_lock:
+                item, self._inbox = self._inbox, None
+            if item is None:
+                return False
+            rec, params = item
+        else:
+            rec = read_latest(self.root)
         if rec is None or rec["version"] <= self.server.policy_version:
             return False
         if rec["version"] == self._failed_version \
                 and self._failed_tries >= self.max_retries:
             return False   # parked: retried enough, wait for a newer one
         try:
-            leaves = load_version(self.root, rec)
+            if self.publisher is not None:
+                import jax
+                leaves = jax.tree_util.tree_leaves(params)
+            else:
+                leaves = load_version(self.root, rec)
             self.server.apply_weights(leaves, rec["version"],
                                       rec["fingerprint"],
                                       meta=rec.get("meta"))

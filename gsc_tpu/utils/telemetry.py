@@ -33,11 +33,20 @@ class PhaseTimer:
     and ``host_sample`` only appears on the serial path (the prefetch
     thread absorbs it on the pipelined path).  A pipelined run should show
     drain+host_sample collapsing toward zero while dispatch grows to cover
-    the device wall."""
+    the device wall.
+
+    Accumulation is lock-protected: the async actor/learner path shares
+    ONE ledger across the actor threads and the learner loop (that is
+    what makes ``actor_idle`` vs ``learner_idle`` comparable on one
+    clock), and an unlocked read-modify-write would drop increments under
+    that interleaving."""
 
     def __init__(self):
+        import threading
+
         self._total: Dict[str, float] = {}
         self._count: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextmanager
     def phase(self, name: str):
@@ -48,15 +57,19 @@ class PhaseTimer:
             self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float):
-        self._total[name] = self._total.get(name, 0.0) + seconds
-        self._count[name] = self._count.get(name, 0) + 1
+        with self._lock:
+            self._total[name] = self._total.get(name, 0.0) + seconds
+            self._count[name] = self._count.get(name, 0) + 1
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """{phase: {total_s, count, mean_ms}} over everything recorded."""
+        with self._lock:
+            totals = dict(self._total)
+            counts = dict(self._count)
         return {
-            name: {"total_s": round(t, 4), "count": self._count[name],
-                   "mean_ms": round(1e3 * t / max(self._count[name], 1), 3)}
-            for name, t in sorted(self._total.items())
+            name: {"total_s": round(t, 4), "count": counts[name],
+                   "mean_ms": round(1e3 * t / max(counts[name], 1), 3)}
+            for name, t in sorted(totals.items())
         }
 
 

@@ -1,0 +1,444 @@
+"""Decoupled actor/learner (Sebulba-style) tests — the PR-16 layer.
+
+Covers: the jitted ``replay_ingest`` ring semantics hand-checked against
+a manual scatter, run_async's drain-proved accounting (produced ==
+ingested, no transition lost, every episode drained exactly once), the
+zero-retrace contract across actor/learner interleavings under
+``assert_no_retrace`` (including ACROSS run_async calls — the warmup /
+measured-window split the bench relies on), the ``max_staleness``
+backpressure bound under an artificially throttled learner, graceful
+stop (nothing lost, nothing hung), bit-identical single-actor replay
+determinism, sync-vs-async learning-curve equivalence within the
+bench_diff curve bands at matched env-step + gradient-step budgets, the
+in-process WeightPublisher subscriber channel (satellite 1), sharded-
+ring byte/fill accounting (satellite 2), Trainer.train_async end-to-end
+with its gauges, and the cli --async flag contract.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gsc_tpu.agents.buffer import buffer_fill_frac, buffer_nbytes
+from gsc_tpu.analysis.sentinels import CompileMonitor
+from gsc_tpu.parallel import ParallelDDPG
+from gsc_tpu.parallel.async_rl import (AsyncConfig, make_replay_ingest,
+                                       run_async)
+
+pytestmark = pytest.mark.async_rl
+
+# bench_diff's curve bands (tools/bench_diff.py METRIC_RULES): relative
+# tolerance with an absolute floor — the SAME gate tools/async_bench.py
+# applies to the banked artifact, asserted here at tiny scale
+CURVE_BANDS = {"final_window_return": (0.20, 1.0), "auc_return": (0.25, 1.0)}
+
+
+def _within(name, a, b):
+    rel, floor = CURVE_BANDS[name]
+    return abs(a - b) <= max(rel * abs(b), floor)
+
+
+def _setup(episode_steps=4, B=2, **agent_kwargs):
+    """Tiny flagship stack (test_parallel's deterministic-setup shape,
+    donate=False per the async contract).  Returns a fresh-ring FACTORY
+    rather than one ring: run_async's jitted replay_ingest donates the
+    ring it is handed, so a shared ring would be a deleted buffer by the
+    second test — pddpg/state/traces are safely reusable, rings are not."""
+    import __graft_entry__ as ge
+    env, agent, topo, traffic0 = ge._flagship(
+        max_nodes=8, max_edges=8, episode_steps=episode_steps,
+        max_flows=32)
+    if agent_kwargs:
+        agent = dataclasses.replace(agent, **agent_kwargs)
+        env.agent = agent
+    traffic = jax.tree_util.tree_map(
+        lambda x: jnp.stack([x] * B), traffic0)
+    pddpg = ParallelDDPG(env, agent, num_replicas=B, donate=False)
+    _, obs = pddpg.reset_all(jax.random.PRNGKey(0), topo, traffic)
+    one_obs = jax.tree_util.tree_map(lambda x: x[0], obs)
+    state = pddpg.init(jax.random.PRNGKey(1), one_obs)
+
+    def make_buffers(**kw):
+        return pddpg.init_buffers(one_obs, **kw)
+
+    return pddpg, state, make_buffers, (lambda ep: (topo, traffic))
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """ONE compiled stack for every vanilla-config test in this module
+    (each instance re-traces its jitted entry points, ~5-8s per setup on
+    the CI box — nine per-test setups were most of this file's tier-1
+    bill).  Tests draw fresh rings from the factory; pddpg and the
+    initial learner state are never mutated on the donate=False path."""
+    return _setup(episode_steps=4)
+
+
+# ------------------------------------------------------ replay_ingest ring
+def test_replay_ingest_ring_semantics():
+    """Hand-checked ring fold: two T=3 blocks into a cap=4 ring wrap
+    exactly like the manual per-slot scatter — per-replica cursors,
+    oldest-overwrite, size clamp."""
+    from gsc_tpu.agents.buffer import ReplayBuffer
+    B, cap, T = 2, 4, 3
+    data = {"x": jnp.zeros((B, cap, 2)), "y": jnp.zeros((B, cap), jnp.int32)}
+    buf = ReplayBuffer(data=data, pos=jnp.zeros(B, jnp.int32),
+                       size=jnp.zeros(B, jnp.int32))
+    ingest = make_replay_ingest(B, cap)
+
+    def block(lo):
+        # replica r, slot t carries value lo + r*10 + t
+        v = lo + 10 * jnp.arange(B)[:, None] + jnp.arange(T)[None, :]
+        return {"x": jnp.stack([v, v], -1).astype(jnp.float32),
+                "y": v.astype(jnp.int32)}
+
+    buf = ingest(buf, block(0))
+    assert np.asarray(buf.pos).tolist() == [3, 3]
+    assert np.asarray(buf.size).tolist() == [3, 3]
+    np.testing.assert_array_equal(np.asarray(buf.data["y"])[:, :3],
+                                  np.asarray(block(0)["y"]))
+    buf = ingest(buf, block(100))
+    # wrapped: slots [3, 0, 1] now hold block(100); slot 2 keeps t=2 of
+    # block(0)
+    assert np.asarray(buf.pos).tolist() == [2, 2]
+    assert np.asarray(buf.size).tolist() == [4, 4]
+    y = np.asarray(buf.data["y"])
+    for r in range(B):
+        assert y[r, 3] == 100 + 10 * r
+        assert y[r, 0] == 101 + 10 * r
+        assert y[r, 1] == 102 + 10 * r
+        assert y[r, 2] == 2 + 10 * r
+    # memoized by (B, cap): the bench's warmup/measure split reuses ONE jit
+    assert make_replay_ingest(B, cap) is ingest
+
+
+def test_replay_ingest_rejects_undersized_ring(stack):
+    pddpg, state, make_buffers, scenario_fn = stack
+    small = make_buffers(capacity=1)
+    with pytest.raises(ValueError, match="capacity"):
+        run_async(pddpg, scenario_fn, state, small, episodes=1,
+                  episode_steps=4, chunk=2, seed=0,
+                  cfg=AsyncConfig(actor_threads=1))
+
+
+# ------------------------------------------------- accounting + interleave
+def test_async_drain_accounting_and_pacing(stack):
+    """Every episode drains exactly once, produced == ingested with no
+    transition lost, and the learner's burst count matches the
+    learn_ratio=1.0 pacing budget (one burst per B*episode_steps ingested
+    steps — the sync control's gradient budget)."""
+    pddpg, state, make_buffers, scenario_fn = stack
+    recs = []
+    res = run_async(pddpg, scenario_fn, state, make_buffers(), episodes=6,
+                    episode_steps=4, chunk=2, seed=0,
+                    cfg=AsyncConfig(actor_threads=2), timer=None,
+                    on_episode=lambda rec, ring: recs.append(rec))
+    info = res.info
+    assert sorted(r["episode"] for r in recs) == list(range(6))
+    assert info["episodes_drained"] == 6
+    assert info["produced_steps"] == 6 * 4 * pddpg.B
+    assert info["ingested_steps"] == info["produced_steps"]
+    assert info["transitions_lost"] == 0
+    assert info["bursts"] == 6
+    assert info["publishes"] >= 1
+    # the ring really filled: 6 episodes * 4 steps, clamped at capacity
+    cap = jax.tree_util.tree_leaves(res.buffers.data)[0].shape[1]
+    assert np.asarray(res.buffers.size).tolist() == \
+        [min(24, cap)] * pddpg.B
+    # every drained record carries the policy version it acted with
+    assert all(r["policy_version"] >= 0 for r in recs)
+    assert {r["actor"] for r in recs} <= {0, 1}
+
+
+def test_async_zero_retrace_across_runs(stack):
+    """Steady state is zero-retrace for every async entry point —
+    INCLUDING a second run_async call (the bench's warmup/measured
+    split): rollout_episodes, reset_all, learn_burst and the memoized
+    replay_ingest must all reuse their first trace."""
+    pddpg, state, make_buffers, scenario_fn = stack
+    mon = CompileMonitor().start()
+    try:
+        res = run_async(pddpg, scenario_fn, state, make_buffers(),
+                        episodes=2,
+                        episode_steps=4, chunk=2, seed=0,
+                        cfg=AsyncConfig(actor_threads=2))
+        with mon.assert_no_retrace("rollout_episodes", "learn_burst",
+                                   "reset_all", "replay_ingest"):
+            res = run_async(pddpg, scenario_fn, res.state, res.buffers,
+                            episodes=6, episode_steps=4, chunk=2, seed=0,
+                            cfg=AsyncConfig(actor_threads=2),
+                            start_episode=2)
+        assert res.info["episodes_drained"] == 4
+    finally:
+        mon.stop()
+
+
+def test_async_staleness_bound_under_throttled_learner(stack):
+    """With the learner artificially slowed (throttle_s) the actors hit
+    the backpressure wall: observed staleness never exceeds the
+    max_staleness bound, actor_idle time accrues, and nothing is lost."""
+    from gsc_tpu.utils.telemetry import PhaseTimer
+    pddpg, state, make_buffers, scenario_fn = stack
+    timer = PhaseTimer()
+    res = run_async(pddpg, scenario_fn, state, make_buffers(), episodes=6,
+                    episode_steps=4, chunk=2, seed=0,
+                    cfg=AsyncConfig(actor_threads=2, max_staleness=4,
+                                    throttle_s=0.1), timer=timer)
+    assert res.info["max_staleness"] <= 4
+    assert res.info["produced_steps"] == res.info["ingested_steps"]
+    assert res.info["transitions_lost"] == 0
+    phases = timer.summary()
+    assert "actor_idle" in phases, "backpressure never engaged"
+
+
+def test_async_graceful_stop_drains_everything(stack):
+    """A stop signal mid-run exits promptly WITHOUT losing transitions:
+    whatever the actors shipped is ingested before return (produced ==
+    ingested), fewer episodes drain than requested, and no thread hangs
+    (run_async returning IS the no-hang proof — actors are joined).
+
+    max_staleness pins production to ingestion (at most one episode's
+    worth of steps outstanding) so the stop deterministically lands
+    mid-run: without backpressure a fast fleet on a loaded box can ship
+    all 50 tiny episodes before the learner drains its second record,
+    and drains-everything-already-produced semantics then legitimately
+    drain all 50."""
+    pddpg, state, make_buffers, scenario_fn = stack
+    drained = []
+
+    def should_stop():
+        return len(drained) >= 2
+
+    res = run_async(pddpg, scenario_fn, state, make_buffers(), episodes=50,
+                    episode_steps=4, chunk=2, seed=0,
+                    cfg=AsyncConfig(actor_threads=2, max_staleness=8),
+                    on_episode=lambda rec, ring: drained.append(rec),
+                    should_stop=should_stop)
+    assert 2 <= res.info["episodes_drained"] < 50
+    assert res.info["produced_steps"] == res.info["ingested_steps"]
+    assert res.info["transitions_lost"] == 0
+
+
+def test_async_deterministic_replay_single_actor():
+    """1 actor with publishing frozen (publish_bursts -> never): two runs
+    from identical seeds produce BIT-identical replay contents, cursors
+    and sizes — the async machinery adds no nondeterminism of its own.
+    ONE stack, run twice: run_async never mutates the handed-in state on
+    the donate=False path, so both runs see identical inputs (and the
+    shared jit traces make the pair cost barely more than one run)."""
+    pddpg, state, make_buffers, scenario_fn = _setup(
+        episode_steps=4, rand_sigma=0.0, rand_mu=0.0)
+
+    def one_run():
+        return run_async(pddpg, scenario_fn, state, make_buffers(),
+                         episodes=3, episode_steps=4, chunk=2, seed=0,
+                         cfg=AsyncConfig(actor_threads=1,
+                                         publish_bursts=10**6))
+    r1, r2 = one_run(), one_run()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        r1.buffers.data, r2.buffers.data)
+    np.testing.assert_array_equal(np.asarray(r1.buffers.pos),
+                                  np.asarray(r2.buffers.pos))
+    np.testing.assert_array_equal(np.asarray(r1.buffers.size),
+                                  np.asarray(r2.buffers.size))
+
+
+def test_async_scenario_stream_thread_count_invariant(stack):
+    """Episodes are keyed by GLOBAL index: the set of scenario indices
+    requested is the same for 1 and 2 actor threads (which THREAD runs
+    an episode may differ; WHAT it trains on may not)."""
+    pddpg, state, make_buffers, scenario_fn = stack
+    seen = {}
+    for n in (1, 2):
+        calls = []
+
+        def spy(ep, _fn=scenario_fn, _calls=calls):
+            _calls.append(ep)
+            return _fn(ep)
+
+        run_async(pddpg, spy, state, make_buffers(), episodes=4,
+                  episode_steps=4, chunk=2, seed=0,
+                  cfg=AsyncConfig(actor_threads=n))
+        seen[n] = sorted(calls)
+    assert seen[1] == seen[2] == list(range(4))
+
+
+# --------------------------------------------- curve equivalence (banded)
+def test_async_curve_matches_sync_within_bands():
+    """Sync control (train_parallel) vs async at MATCHED budgets — same
+    episodes, same replicas, learn_ratio=1.0 — land inside bench_diff's
+    curve bands (final-window return 20%/floor 1.0, AUC 25%/floor 1.0).
+    Banded, not bit-exact: actors act on K-burst-old weights by design."""
+    from gsc_tpu.agents.trainer import Trainer
+    from tests.test_agent import make_driver, make_stack
+
+    def curve(async_mode, tmp):
+        env, agent, topo, traffic = make_stack()
+        driver = make_driver(env, agent, topo, traffic)
+        tr = Trainer(env, driver, agent, seed=0, result_dir=tmp)
+        if async_mode:
+            tr.train_async(episodes=6, num_replicas=2, chunk=2,
+                           actor_threads=2)
+        else:
+            tr.train_parallel(episodes=6, num_replicas=2, chunk=2)
+        hist = sorted(tr.history, key=lambda r: r["episode"])
+        rets = [r["episodic_return"] for r in hist]
+        w = rets[-3:]
+        return sum(w) / len(w), sum(rets) / len(rets)
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2:
+        s_final, s_auc = curve(False, d1)
+        a_final, a_auc = curve(True, d2)
+    assert np.isfinite([s_final, s_auc, a_final, a_auc]).all()
+    assert _within("final_window_return", a_final, s_final), \
+        (a_final, s_final)
+    assert _within("auc_return", a_auc, s_auc), (a_auc, s_auc)
+
+
+# ------------------------------------------- satellite 1: publisher channel
+def test_weight_publisher_inprocess_subscribers(tmp_path):
+    """WeightPublisher(subscribers=[...]) without a root: publishes are
+    file-system-free, subscribers get (record, params) zero-copy, and a
+    VersionWatcher in publisher mode adopts them; a broken subscriber
+    never fails the publish."""
+    from gsc_tpu.serve.fleet import VersionWatcher, WeightPublisher
+
+    got = []
+    pub = WeightPublisher(subscribers=[lambda rec, p: got.append((rec, p))])
+    params = {"w": jnp.arange(3.0)}
+    rec = pub.publish(params, meta={"k": 1})
+    assert rec["version"] == 1 and rec.get("blob") is None
+    assert got and got[0][0]["version"] == 1
+    assert got[0][1] is params            # zero-copy, never serialized
+
+    class Server:
+        policy_version = -1
+
+        def apply_weights(self, leaves, version, fingerprint, meta=None):
+            self.leaves, self.policy_version = leaves, version
+
+    srv = Server()
+    w = VersionWatcher(None, srv, publisher=pub)
+    assert not w.poll_once()              # inbox empty until a publish
+    pub.publish({"w": jnp.ones(3)})
+    assert w.poll_once()
+    assert srv.policy_version == 2
+    np.testing.assert_array_equal(np.asarray(srv.leaves[0]), np.ones(3))
+    w.stop()
+    # unsubscribed: later publishes no longer reach the dead watcher
+    n = len(got)
+    pub.subscribe(lambda rec, p: 1 / 0)   # broken subscriber
+    pub.publish({"w": jnp.zeros(3)})      # must not raise
+    assert len(got) == n + 1
+
+    # file mode unchanged: root-backed publisher still writes artifacts
+    # (byte-path contract for the fleet) AND notifies subscribers
+    got2 = []
+    pub2 = WeightPublisher(str(tmp_path), subscribers=[
+        lambda rec, p: got2.append(rec)])
+    rec2 = pub2.publish(params)
+    assert rec2["fingerprint"] and got2[0]["version"] == rec2["version"]
+    from gsc_tpu.serve.fleet import read_latest
+    assert read_latest(str(tmp_path))["version"] == rec2["version"]
+
+
+def test_version_watcher_requires_a_source():
+    from gsc_tpu.serve.fleet import VersionWatcher
+    with pytest.raises(ValueError, match="root.*publisher|publisher.*root"):
+        VersionWatcher(None, object())
+
+
+# --------------------------------------- satellite 2: sharded ring gauges
+def test_buffer_accounting_sharded_ring():
+    """buffer_nbytes(local=) and buffer_fill_frac on a replica-sharded
+    [B, cap] ring: jax Array.size is GLOBAL, so per-shard accounting must
+    sum addressable shard bytes (== global on this single-process mesh,
+    with each element counted exactly once), and the fill fraction
+    reduces the per-replica size vector globally."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from gsc_tpu.agents.buffer import ReplayBuffer
+    B, cap = 8, 4
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("dp",))
+    sh = NamedSharding(mesh, PartitionSpec("dp"))
+    data = {"x": jax.device_put(jnp.zeros((B, cap, 3)), sh)}
+    buf = ReplayBuffer(
+        data=data,
+        pos=jax.device_put(jnp.zeros(B, jnp.int32), sh),
+        size=jax.device_put(jnp.asarray([1, 2, 3, 4, 4, 4, 0, 2],
+                                        jnp.int32), sh))
+    # buffer_nbytes accounts the DATA leaves (the HBM resident the gauge
+    # tracks); the per-replica pos/size cursors are not storage
+    want = B * cap * 3 * 4
+    assert buffer_nbytes(buf) == want
+    assert buffer_nbytes(buf, local=True) == want   # all shards local here
+    # shard accounting counts each element ONCE (no per-device inflation)
+    assert buffer_fill_frac(buf) == pytest.approx((1+2+3+4+4+4+0+2)
+                                                  / (B * cap))
+    # unsharded single-ring path still agrees
+    from gsc_tpu.agents.buffer import buffer_init
+    one = buffer_init({"x": jnp.zeros(3)}, capacity=4)
+    assert buffer_nbytes(one) == buffer_nbytes(one, local=True)
+    assert buffer_fill_frac(one) == 0.0
+
+
+# ------------------------------------------------------- trainer + cli e2e
+def test_trainer_train_async_e2e_gauges(tmp_path):
+    """Trainer.train_async under a RunObserver: all episodes complete,
+    async_info proves the drain, and the new gauges/phases land in the
+    metrics snapshot (policy_lag, replay_lag, learner_idle_frac,
+    replay_fill_frac, actor_dispatch/learner_idle phase histograms)."""
+    import json
+    from gsc_tpu.agents.trainer import Trainer
+    from gsc_tpu.obs import RunObserver
+    from tests.test_agent import make_driver, make_stack
+
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    obs = RunObserver(str(tmp_path / "obs"), run_id="asyncrun")
+    obs.start(meta={"episodes": 3})
+    tr = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path),
+                 obs=obs)
+    state, buffers = tr.train_async(episodes=3, num_replicas=2, chunk=2,
+                                    actor_threads=2)
+    obs.close()
+    assert tr.completed_episodes == 3
+    info = tr.async_info
+    assert info["produced_steps"] == info["ingested_steps"]
+    assert info["transitions_lost"] == 0
+    assert len(tr.history) == 3
+    snap = json.load(open(tmp_path / "obs" / "metrics.json"))["metrics"]
+    for g in ("gsc_policy_lag", "gsc_replay_lag", "gsc_learner_idle_frac",
+              "gsc_replay_fill_frac", "gsc_actor_policy_version"):
+        assert any(k.startswith(g + "{") for k in snap), g
+    assert any('phase="actor_dispatch"' in k for k in snap)
+    assert any('phase="learner_idle"' in k for k in snap)
+    # the learner state trained: same leaves as a sync state, all finite
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(state.actor_params))
+
+
+def test_cli_async_flag_contract():
+    """--async validation fails fast with the flag's name: without
+    --replicas > 1, combined with --mesh, and async tuning knobs without
+    --async are all usage errors before any build."""
+    from click.testing import CliRunner
+    from gsc_tpu.cli import cli
+
+    runner = CliRunner()
+    base = ["train", "a.yaml", "s.yaml", "v.yaml", "d.yaml"]
+    r = runner.invoke(cli, base + ["--async"])
+    assert r.exit_code != 0 and "--replicas" in r.output
+    r = runner.invoke(cli, base + ["--async", "--replicas", "2",
+                                   "--mesh", "2x1"])
+    assert r.exit_code != 0 and "--mesh" in r.output
+    r = runner.invoke(cli, base + ["--async-actors", "4"])
+    assert r.exit_code != 0 and "--async" in r.output
+    r = runner.invoke(cli, base + ["--async", "--replicas", "2",
+                                   "--async-actors", "0"])
+    assert r.exit_code != 0 and "--async-actors" in r.output

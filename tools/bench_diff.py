@@ -93,6 +93,13 @@ METRIC_RULES: List[Tuple] = [
     ("slo_queue_wait_frac", False, 0.30, 0.05),
     ("slo_burn_rate", False, 0.25, 0.25),
     ("slo_attainment", True, 0.05, 0.02),
+    # async actor/learner rows (ASYNC_r*, tools/async_bench.py): the
+    # learner-idle fraction is the decoupling claim itself — the learner
+    # must not creep back toward blocking on acting.  Lower is better; a
+    # healthy run sits near zero, so the band carries an absolute floor
+    # in ratio units (the per-leg *_sps rates gate under the shared 15%
+    # `sps` band above, and per-leg trace counts under `jit_traces`).
+    ("learner_idle_frac", False, 0.25, 0.05),
 ]
 
 # filename patterns `ingest --scan` picks up.  perf.json ledgers and
@@ -100,8 +107,8 @@ METRIC_RULES: List[Tuple] = [
 # at results/<id>/<timestamp>/ (utils.experiment.setup_result_dir
 # layout), arbitrarily deep below the scan root.
 SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
-                 "MIXTOPO_r*.json", "SCEN_r*.json", "**/perf.json",
-                 "**/curves.json", "**/slo.json")
+                 "MIXTOPO_r*.json", "SCEN_r*.json", "ASYNC_r*.json",
+                 "**/perf.json", "**/curves.json", "**/slo.json")
 
 
 def metric_rule(name: str) -> Optional[Tuple[bool, float, float]]:
@@ -134,7 +141,14 @@ def _bench_row(d: Dict) -> Dict:
         # the ratios and the scenario_regen walls are context
         for k in ("mixed_sps", "homogeneous_sps", "mixed_vs_homogeneous",
                   "factory_sps", "host_regen_sps", "factory_vs_host",
-                  "factory_scenario_regen_s", "host_scenario_regen_s"):
+                  "factory_scenario_regen_s", "host_scenario_regen_s",
+                  # ASYNC rounds: sync control + per-actor-count async
+                  # rates (`_sps` band), the learner-idle fraction (its
+                  # own lower-is-better band), speedups + curve metrics
+                  "sync_sps", "async1_sps", "async2_sps", "async4_sps",
+                  "learner_idle_frac", "async2_vs_sync", "async4_vs_sync",
+                  "sync_final_window_return", "async_final_window_return",
+                  "sync_auc_return", "async_auc_return"):
             if _num(d.get(k)) is not None:
                 metrics[k] = float(d[k])
         for fn, n in (d.get("jit_traces") or {}).items():
@@ -142,7 +156,8 @@ def _bench_row(d: Dict) -> Dict:
                 metrics[f"{fn}_jit_traces"] = float(n)
         # MIXTOPO/SCEN rounds record per-leg trace counts; keys end in
         # `_jit_traces` so the 0%-tolerance retrace band gates them too
-        for leg in ("homogeneous", "mixed", "factory", "host_regen"):
+        for leg in ("homogeneous", "mixed", "factory", "host_regen",
+                    "sync", "async1", "async2", "async4"):
             for fn, n in (d.get(f"jit_traces_{leg}") or {}).items():
                 if _num(n) is not None:
                     metrics[f"{leg}_{fn}_jit_traces"] = float(n)
@@ -153,7 +168,9 @@ def _bench_row(d: Dict) -> Dict:
     return {"kind": "bench", "status": status, "metrics": metrics,
             "context": {k: d.get(k) for k in
                         ("pipeline", "precision", "substep_impl", "unroll",
-                         "mesh", "topo_mix") if k in d}}
+                         "mesh", "topo_mix", "async_actors",
+                         "policy_lag_max", "produced_steps",
+                         "ingested_steps") if k in d}}
 
 
 def _multichip_row(d: Dict) -> Dict:

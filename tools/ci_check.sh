@@ -111,6 +111,17 @@ echo "== scenario smoke (on-device factory + auto-curriculum, zero retraces) =="
 # regression rc 1) — tools/scenario_smoke.py asserts all of it
 env JAX_PLATFORMS=cpu python tools/scenario_smoke.py
 
+echo "== async smoke (decoupled actor/learner through the real CLI) =="
+# a tiny 3-episode --async run (2 replicas, 2 actors, --no-perf) must
+# rc=0 with EXACTLY one trace each for rollout_episodes/reset_all/
+# learn_burst/replay_ingest across every actor/learner interleaving,
+# the drain-proved async_train tail (produced == ingested, zero lost),
+# policy_lag/replay_lag/learner_idle_frac gauges + actor/learner phase
+# histograms in metrics.json, and an ASYNC-shaped row gating through
+# bench_diff (self-compare rc 0, injected env-steps/s regression rc 1)
+# — tools/async_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/async_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl
@@ -125,7 +136,7 @@ trap 'rm -f "$T1LOG"' EXIT
 # `|| rc=$?` keeps set -e from aborting at a red pytest pipeline — the
 # DOTS_PASSED tally must print precisely on failing runs
 rc=0
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG" || rc=$?
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$T1LOG" \
