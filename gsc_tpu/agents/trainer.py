@@ -1492,6 +1492,14 @@ class Trainer:
         self._last_drained = start_episode - 1
         if self.obs:
             self.obs.resume_watchdog()
+            # fleet watchdog coverage: every actor thread + the learner
+            # register their own heartbeats (run_async beats them per
+            # chunk / per loop pass), so a stall event names the wedged
+            # thread and the phase it is stuck in — blocked_put vs
+            # dispatch vs adopt — instead of an anonymous quiet episode
+            self.obs.watch_fleet(
+                [f"actor{a}" for a in range(max(1, actor_threads))]
+                + ["learner"])
 
         start = time.time()
         drained_n = [0]
@@ -1591,6 +1599,10 @@ class Trainer:
                                else None))
         finally:
             if self.obs:
+                # drop the per-thread watches BEFORE pausing: a paused
+                # watchdog keeps its registry, and the next (sync) loop
+                # must not inherit actor heartbeats nobody beats anymore
+                self.obs.unwatch_fleet()
                 self.obs.pause_watchdog()
         if preempt is not None and preempt.triggered:
             self.preempted = True
@@ -1599,6 +1611,17 @@ class Trainer:
                 action="preempt_snapshot", fault=preempt.signame,
                 detail="async run drained and stopped; the caller "
                        "checkpoints the drained state")
+            if self.obs:
+                # SIGTERM post-mortem (the PR 5 recovery path): the same
+                # black-box dump a wedged fleet gets, tagged with the
+                # signal — best effort, a failed dump must not block the
+                # preemption snapshot itself
+                try:
+                    self.obs.write_blackbox(
+                        reason=f"preempt:{preempt.signame}")
+                except Exception:
+                    log.warning("preempt black-box dump failed",
+                                exc_info=True)
         self.completed_episodes = self._last_drained + 1
         self.async_info = res.info
         if hub is not None:

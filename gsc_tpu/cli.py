@@ -358,6 +358,14 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "to events.jsonl.1..N (readers — obs_report, the trace "
                    "exporter — walk the segments transparently; 0 = no "
                    "rotation)")
+@click.option("--obs-series-window", default=1024, show_default=True,
+              help="flight recorder: points kept per metric in the hub's "
+                   "bounded time-series rings (drop-oldest).  Feeds the "
+                   "whole-run series.json, the /series endpoint query, "
+                   "the async pipeline trace tracks and the black-box "
+                   "post-mortem dumps.  0 disables history entirely — "
+                   "the event stream is then byte-identical to a "
+                   "recorder-free run")
 @click.option("--perf/--no-perf", "perf_enabled", default=True,
               show_default=True,
               help="device-cost ledger: capture compiled FLOPs/bytes/"
@@ -491,7 +499,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
           profile, runs, resume, resource_functions_path, replicas, chunk,
           mesh, partition_rules, topo_mix, pipeline, precision,
           substep_impl, unroll, obs_enabled, obs_dir, obs_interval,
-          obs_rotate_mb, perf_enabled, learnobs_enabled, metrics_port,
+          obs_rotate_mb, obs_series_window, perf_enabled,
+          learnobs_enabled, metrics_port,
           watchdog_budget, watchdog_escalate,
           check_invariants, fault_plan, rollback, ckpt_interval,
           ckpt_retain, hot_swap_dir, publish_interval, async_mode,
@@ -740,6 +749,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                               rotate_mb=obs_rotate_mb, perf=perf_enabled,
                               learn=learnobs_enabled,
                               metrics_port=(metrics_port or None),
+                              series_window=obs_series_window,
                               tags={"seed": run_seed})
             obs.start(meta={"episodes": episodes, "replicas": replicas,
                             "pipeline": pipeline, "seed": run_seed,
@@ -1069,6 +1079,12 @@ def infer(agent_config, simulator_config, service, scheduler, checkpoint,
 @click.option("--obs-dir", default=None,
               help="directory for events.jsonl/metrics.json "
                    "(default: the run's result dir)")
+@click.option("--obs-series-window", default=1024, show_default=True,
+              help="flight recorder: points kept per metric in the hub's "
+                   "time-series rings (the fleet dispatcher samples "
+                   "queue depth, bucket occupancy, burn and pad waste "
+                   "into them at the burn-refresh cadence; series.json "
+                   "and /series read them back).  0 disables history")
 @click.option("--perf/--no-perf", "perf_enabled", default=True,
               show_default=True,
               help="device-cost ledger over the serving buckets: each "
@@ -1103,8 +1119,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
           workers, brownout_burn, hot_swap_dir, swap_poll_s, fire_swaps,
           artifact_cache, pool_steps, stats_interval, request_timeout,
           seed, max_nodes, max_edges, resource_functions_path, result_dir,
-          obs_enabled, obs_dir, perf_enabled, metrics_port, trace_sample,
-          slo_p99_ms, jax_cache_dir):
+          obs_enabled, obs_dir, obs_series_window, perf_enabled,
+          metrics_port, trace_sample, slo_p99_ms, jax_cache_dir):
     """Serve coordination decisions from an AOT-compiled greedy policy.
 
     With CHECKPOINT: restores the actor, ahead-of-time compiles the
@@ -1211,7 +1227,8 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
         from .obs import RunObserver
         obs_rec = RunObserver(obs_dir or rdir, tags={"seed": seed},
                               perf=perf_enabled,
-                              metrics_port=(metrics_port or None))
+                              metrics_port=(metrics_port or None),
+                              series_window=obs_series_window)
         obs_rec.start(meta={
             "mode": "serve", "tier": tier, "seed": seed,
             "requests": requests, "concurrency": concurrency,

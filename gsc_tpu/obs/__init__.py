@@ -66,13 +66,18 @@ from .hub import MetricsHub
 from .learning import LearnLedger, LearnLedgerSpec, emit_learn_signal
 from .perf import PERF_SCHEMA_VERSION, CostLedger
 from .run import RunObserver
-from .sinks import JsonlSink, ListSink, rotated_paths, write_atomic_json
+from .series import (BLACKBOX_SCHEMA_VERSION, SERIES_SCHEMA_VERSION,
+                     SeriesStore, write_blackbox, write_series)
+from .sinks import (JsonlSink, ListSink, TailSink, rotated_paths,
+                    write_atomic_json)
 from .slo import (SLO_SCHEMA_VERSION, ServeTracer, SLOEngine,
                   SLOObjectives, parse_slo_spec, write_slo_json)
 from .watchdog import PipelineWatchdog
 
 __all__ = [
-    "MetricsHub", "JsonlSink", "ListSink", "write_atomic_json",
+    "MetricsHub", "JsonlSink", "ListSink", "TailSink", "SeriesStore",
+    "SERIES_SCHEMA_VERSION", "BLACKBOX_SCHEMA_VERSION", "write_series",
+    "write_blackbox", "write_atomic_json",
     "rotated_paths", "device_memory_snapshot", "record_device_gauges",
     "PipelineWatchdog", "RunObserver", "CostLedger",
     "PERF_SCHEMA_VERSION", "LearnLedger", "LearnLedgerSpec",

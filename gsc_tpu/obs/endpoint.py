@@ -16,7 +16,10 @@ mid-run reads the CURRENT value, not the last event-writer sample.  Wired via ``
 ``cli train --metrics-port`` (default off); ``cli serve`` reuses it for
 the serving hub.
 
-Routes: ``/metrics`` (Prometheus text), ``/healthz`` (JSON liveness).
+Routes: ``/metrics`` (Prometheus text), ``/healthz`` (JSON liveness),
+``/series`` (read-only JSON time-series query over the hub's flight-
+recorder rings: ``/series?name=<bare metric>&since=<unix ts>`` — both
+parameters optional; 404 when the hub runs without a series window).
 """
 from __future__ import annotations
 
@@ -24,6 +27,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
+from urllib.parse import parse_qs, urlparse
 
 # the exposition version Prometheus scrapers negotiate on
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -53,9 +57,39 @@ class _Handler(BaseHTTPRequestHandler):
                                "series": len(self.server.hub.snapshot()),
                                }).encode()
             self._reply(200, "application/json", body)
+        elif path == "/series":
+            self._reply_series()
         else:
             self._reply(404, "text/plain",
-                        b"not found (routes: /metrics, /healthz)\n")
+                        b"not found (routes: /metrics, /healthz, "
+                        b"/series)\n")
+
+    def _reply_series(self):
+        """Read-only JSON history query — the autoscaler-shaped consumer
+        interface (same payload shape as the on-disk ``series.json``).
+        One store read per request; never touches the training loop."""
+        store = getattr(self.server.hub, "series_store", None)
+        if store is None:
+            self._reply(404, "application/json", json.dumps(
+                {"error": "series history disabled "
+                          "(hub has no series window)"}).encode())
+            return
+        query = parse_qs(urlparse(self.path).query)
+        name = (query.get("name") or [None])[0] or None
+        since = None
+        raw = (query.get("since") or [None])[0]
+        if raw:
+            try:
+                since = float(raw)
+            except ValueError:
+                self._reply(400, "application/json", json.dumps(
+                    {"error": f"bad since={raw!r} (want a unix "
+                              "timestamp)"}).encode())
+                return
+        doc = store.document(run=self.server.hub.base_tags.get("run"))
+        if name is not None or since is not None:
+            doc["series"] = store.query(name=name, since=since)
+        self._reply(200, "application/json", json.dumps(doc).encode())
 
     def _reply(self, code: int, ctype: str, body: bytes):
         self.send_response(code)

@@ -80,7 +80,8 @@ class MetricsHub:
     heartbeat registry the :class:`~gsc_tpu.obs.watchdog.PipelineWatchdog`
     polls and the event fan-out the JSONL stream hangs off."""
 
-    def __init__(self, tags: Optional[Dict[str, object]] = None):
+    def __init__(self, tags: Optional[Dict[str, object]] = None,
+                 series_window: int = 0):
         self._lock = threading.RLock()
         self.base_tags: Dict[str, str] = {
             k: str(v) for k, v in (tags or {}).items()}
@@ -91,7 +92,19 @@ class MetricsHub:
         self._beats: Dict[str, float] = {}       # name -> time.monotonic()
         self._last_phase: Optional[str] = None
         self._last_phase_done = False
+        # per-thread pipeline phase (fleet watchdog coverage): a wedged
+        # actor's stall event names the phase IT was in, not the main
+        # loop's
+        self._thread_phases: Dict[str, str] = {}
         self._sinks: list = []
+        # time-series rings (the flight recorder; ``--obs-series-window``):
+        # None = history off, series() is a no-op and every snapshot /
+        # event byte stays identical to the history-free hub
+        self.series_store = None
+        if series_window and series_window > 0:
+            from .series import SeriesStore
+            self.series_store = SeriesStore(window=series_window,
+                                            base_tags=self.base_tags)
 
     # ------------------------------------------------------------- series
     def counter(self, name: str, inc: float = 1.0, **tags) -> float:
@@ -127,6 +140,16 @@ class MetricsHub:
     def drop_live_gauge(self, name: str, **tags):
         with self._lock:
             self._live_gauges.pop(_key(name, tags), None)
+
+    def series(self, name: str, value: float, ts: Optional[float] = None,
+               **tags):
+        """Append one ``(ts, value)`` point to the metric's bounded ring
+        (drop-oldest; the flight recorder's history).  A no-op when the
+        hub was built without a series window, so feed sites never need
+        to gate themselves.  The store has its own lock — a series feed
+        never contends with snapshot scrapes on the hub lock."""
+        if self.series_store is not None:
+            self.series_store.add_point(name, value, ts=ts, **tags)
 
     def observe(self, name: str, value: float, **tags):
         """Histogram sample (count/sum/min/max + windowed percentiles)."""
@@ -177,6 +200,23 @@ class MetricsHub:
     def last_phase(self) -> Tuple[Optional[str], bool]:
         with self._lock:
             return self._last_phase, self._last_phase_done
+
+    def note_thread_phase(self, thread: str, phase: str):
+        """Track the phase one named pipeline thread (actor0, learner,
+        ...) is currently in — the fleet watchdog reports it when THAT
+        thread's heartbeat goes quiet, so a stall says ``blocked_put``
+        vs ``dispatch`` vs ``adopt`` instead of pointing at the main
+        loop."""
+        with self._lock:
+            self._thread_phases[thread] = phase
+
+    def thread_phase(self, thread: str) -> Optional[str]:
+        with self._lock:
+            return self._thread_phases.get(thread)
+
+    def thread_phases(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._thread_phases)
 
     # -------------------------------------------------------------- events
     def add_sink(self, sink):

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional
 
 from .device import record_device_gauges
 from .hub import MetricsHub
-from .sinks import JsonlSink, write_atomic_json
+from .sinks import JsonlSink, TailSink, write_atomic_json
 from .watchdog import PipelineWatchdog
 
 log = logging.getLogger("gsc_tpu.obs.run")
@@ -40,21 +40,37 @@ class RunObserver:
                  rotate_mb: float = 0.0,
                  perf: bool = False,
                  learn: bool = False,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 series_window: int = 0,
+                 blackbox_window_s: float = 30.0):
         self.out_dir = os.path.abspath(out_dir)
         os.makedirs(self.out_dir, exist_ok=True)
         run_id = run_id or os.path.basename(self.out_dir.rstrip(os.sep))
-        self.hub = MetricsHub(tags={"run": run_id, **(tags or {})})
+        # flight recorder (``--obs-series-window``): bounded per-metric
+        # time-series rings in the hub.  0 = off — series() no-ops, no
+        # tail sink is attached, and the event stream stays byte-
+        # identical to the history-free observer
+        self.hub = MetricsHub(tags={"run": run_id, **(tags or {})},
+                              series_window=series_window)
         self.events_path = os.path.join(self.out_dir, "events.jsonl")
         self.snapshot_path = os.path.join(self.out_dir, "metrics.json")
         self.perf_path = os.path.join(self.out_dir, "perf.json")
         self.curves_path = os.path.join(self.out_dir, "curves.json")
+        self.series_path = os.path.join(self.out_dir, "series.json")
+        self.blackbox_path = os.path.join(self.out_dir, "blackbox.json")
         # serving SLO summary (obs.slo): PolicyServer.close() writes it
         # when `cli serve` hands the server this path
         self.slo_path = os.path.join(self.out_dir, "slo.json")
         # size-based rotation for 100+-episode exhibits (``--obs-rotate-mb``)
         # — readers walk the rotated segments via sinks.rotated_paths
         self.hub.add_sink(JsonlSink(self.events_path, rotate_mb=rotate_mb))
+        # black-box event tail: the last-N pending events a post-mortem
+        # dump flushes when the fleet dies mid-write
+        self.blackbox_window_s = float(blackbox_window_s)
+        self._tail_sink = None
+        if self.hub.series_store is not None:
+            self._tail_sink = TailSink()
+            self.hub.add_sink(self._tail_sink)
         # device-cost ledger (obs.perf.CostLedger): opt-in because each
         # captured entry point costs one extra AOT trace at setup time —
         # the CLI enables it by default (--perf), bare test observers
@@ -88,9 +104,14 @@ class RunObserver:
             # escalation (``watchdog_escalate`` extra quiet periods before
             # acting) stays report-only until the trainer installs its
             # ``on_escalate`` hook for the duration of the episode loop
-            self.watchdog = PipelineWatchdog(self.hub, watchdog_budget_s,
-                                             start_paused=True,
-                                             escalate_after=watchdog_escalate)
+            self.watchdog = PipelineWatchdog(
+                self.hub, watchdog_budget_s, start_paused=True,
+                escalate_after=watchdog_escalate,
+                # a stall that outlives the escalation horizon flushes
+                # the black-box dump — a dead fleet leaves a post-mortem
+                on_blackbox=lambda thread, age: self.write_blackbox(
+                    reason=f"watchdog_escalation:{thread}",
+                    extra={"age_s": round(age, 3)}))
         # retrace sentinel (analysis.sentinels.CompileMonitor): counts jit
         # traces / XLA compiles per watched entry point and emits one
         # `compile` event per occurrence into events.jsonl — a retrace
@@ -170,6 +191,23 @@ class RunObserver:
                            stalls=self.hub.get_counter("stalls"),
                            recoveries=self.hub.get_counter(
                                "recoveries_total"))
+            if self.hub.series_store is not None:
+                # whole-run history next to the snapshot — best effort,
+                # like the perf/curves writers
+                try:
+                    from .series import write_series
+                    write_series(self.series_path, self.hub.series_store,
+                                 run=self.hub.base_tags.get("run"))
+                except Exception:
+                    pass
+            if status not in ("ok", "preempted"):
+                # a run dying on an exception leaves the same post-mortem
+                # a wedged fleet does (the preempted path writes its own,
+                # tagged with the signal, before the trainer returns)
+                try:
+                    self.write_blackbox(reason=f"run_end:{status}")
+                except Exception:
+                    pass
             self.write_snapshot()
         finally:
             self.hub.close()
@@ -189,6 +227,39 @@ class RunObserver:
     def pause_watchdog(self):
         if self.watchdog is not None:
             self.watchdog.pause()
+
+    def watch_fleet(self, names, budget_s: Optional[float] = None):
+        """Register per-thread heartbeats (actors + learner) with the
+        watchdog for the duration of an async run — a wedged thread's
+        stall event names it and the phase it is stuck in."""
+        if self.watchdog is not None:
+            for name in names:
+                self.watchdog.watch_thread(name, budget_s=budget_s)
+
+    def unwatch_fleet(self):
+        if self.watchdog is not None:
+            self.watchdog.unwatch_all_threads()
+
+    def write_blackbox(self, reason: str,
+                       extra: Optional[Dict] = None) -> Optional[str]:
+        """Flush the post-mortem: last ``blackbox_window_s`` seconds of
+        every series ring + the pending event tail + heartbeat ages and
+        per-thread phases, atomically to ``blackbox.json``.  Called from
+        the watchdog's escalation hook, the SIGTERM path and the
+        error-status close; safe (and useful) even with the series store
+        disabled — the event tail is empty then, but the heartbeat/phase
+        picture still lands."""
+        from .series import write_blackbox
+        return write_blackbox(
+            self.blackbox_path, reason,
+            store=self.hub.series_store,
+            events=(self._tail_sink.tail() if self._tail_sink is not None
+                    else []),
+            window_s=self.blackbox_window_s,
+            heartbeats=self.hub.beat_ages(),
+            thread_phases=self.hub.thread_phases(),
+            run=self.hub.base_tags.get("run"),
+            extra=extra)
 
     def record_precision(self, policy):
         """Dtype-policy gauges + one ``precision`` event (policy is a
@@ -244,13 +315,22 @@ class RunObserver:
         self.hub.counter("episodes_drained")
         self.hub.gauge("sps", sps)
         self.hub.gauge("episode", episode)
+        # flight-recorder history rides the SAME values the gauges get,
+        # at the same instant — the last ring point of every fed metric
+        # always equals the final metrics.json snapshot (series() no-ops
+        # when the recorder is off)
+        self.hub.series("sps", sps)
+        self.hub.series("episode", episode)
         for k, v in metrics.items():
             try:
-                self.hub.gauge(k, float(v))
+                fv = float(v)
             except (TypeError, ValueError):
-                pass   # non-scalar stat (kept in the event record only)
+                continue   # non-scalar stat (kept in the event record only)
+            self.hub.gauge(k, fv)
+            self.hub.series(k, fv)
         if replay_bytes is not None:
             self.hub.gauge("replay_bytes", replay_bytes)
+            self.hub.series("replay_bytes", replay_bytes)
         if truncated_arrivals:
             self.hub.counter("truncated_arrivals_total", truncated_arrivals)
         for reason, n in (drop_reasons or {}).items():

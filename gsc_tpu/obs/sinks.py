@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from collections import deque
 from typing import Dict, List
 
 import numpy as np
@@ -96,6 +97,29 @@ class JsonlSink:
             if self._file is not None:
                 self._file.close()
                 self._file = None
+
+
+class TailSink:
+    """Bounded in-memory tail of the event stream — the black-box dump's
+    "pending events" source.  Keeps the last ``maxlen`` records (already
+    JSON-round-tripped, so the dump writes exactly what the JSONL reader
+    would have seen); drop-oldest, thread-safe, O(1) per emit."""
+
+    def __init__(self, maxlen: int = 256):
+        self._records = deque(maxlen=int(maxlen))
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict):
+        line = json.dumps(record, default=jsonable)
+        with self._lock:
+            self._records.append(json.loads(line))
+
+    def tail(self) -> List[Dict]:
+        with self._lock:
+            return list(self._records)
+
+    def close(self):
+        pass
 
 
 class ListSink:

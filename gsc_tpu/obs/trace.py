@@ -24,7 +24,16 @@ recovery/rollback ladders chained by flow arrows, batcher flushes as
 complete slices on the serve track, and head-sampled
 ``serve_request_span`` events as slices on the serve_request track
 whose flow arrows link each request through its batcher flush to the
-device call that answered it.  Phase sub-spans are RECONSTRUCTED from the
+device call that answered it.  The async flight-recorder records
+(``async_actor_ep`` / ``async_learner_spans``, emitted deferred at run
+end by ``run_async`` when the hub keeps series history) reconstruct the
+decoupled fleet: one track per actor (rollout slices, backpressure-wait
+``put`` slices, ``adopt`` marks), a channel track (each block's queued
+put->pop residency), and a learner track (``replay_ingest`` /
+``learn_burst`` slices, ``publish`` marks) — with put->pop flow arrows
+carrying block size + staleness wait and publish->adopt arrows linking
+every weight version to each actor that adopted it.
+Phase sub-spans are RECONSTRUCTED from the
 cumulative per-episode deltas (laid back-to-back inside each episode's
 span and clamped to it), so they show relative share faithfully but not
 exact start times.  :func:`validate_trace` is the strict schema check
@@ -86,7 +95,13 @@ TRACE_TRACKS = {
     "recovery": 6,       # self-healing ladder, chained by flow arrows
     "serve_request": 7,  # head-sampled request spans, flow-linked to the
                          # batcher flush that answered them
+    "channel": 8,        # async actor->learner conduit: one slice per
+                         # block's queued residency (put -> pop)
+    "learner": 9,        # async learner: ingest + learn_burst slices,
+                         # publish marks (flow-linked to actor adopts)
 }
+# per-actor async tracks start here: actor a renders on tid BASE + a
+ACTOR_TRACK_BASE = 16
 # phase sub-span layout order inside an episode slice (the obs schema's
 # cumulative PhaseTimer names)
 _TRACE_PHASES = ("host_sample", "host_sample_wait", "dispatch", "drain")
@@ -181,7 +196,23 @@ def build_trace(events: List[Dict]) -> Dict:
     # clocks render best-effort.)  Stable: same-ts events keep caller
     # order.
     events = sort_events(events)
-    t0 = min(float(e["ts"]) for e in events)
+    # the async flight-recorder records (``async_actor_ep`` /
+    # ``async_learner_spans``) are emitted DEFERRED at run end but carry
+    # their own wall timestamps from mid-run — the trace origin must
+    # include those payload times or every reconstructed span would land
+    # at a negative offset and fail the strict validator
+    t_min = [float(e["ts"]) for e in events]
+    for e in events:
+        k = e.get("event")
+        if k == "async_actor_ep":
+            t_min.extend(float(r[0]) for r in (e.get("chunks") or []))
+            t_min.extend(float(r[0]) - float(r[1])
+                         for r in (e.get("puts") or []))
+            t_min.extend(float(r[0]) for r in (e.get("adopts") or []))
+        elif k == "async_learner_spans":
+            for field in ("ingests", "bursts", "publishes"):
+                t_min.extend(float(r[0]) for r in (e.get(field) or []))
+    t0 = min(t_min)
     run = next((e.get("run") for e in events if e.get("run")), "run")
     out: List[Dict] = []
 
@@ -230,6 +261,27 @@ def build_trace(events: List[Dict]) -> Dict:
                 for e in events
                 if e.get("event") == "serve_flush"
                 and e.get("flush_id") is not None}
+    # async flight-recorder indices (same per-segment keying): put->pop
+    # flows need each block's ingest start by seq, publish->adopt flows
+    # need each version's publish time; both live in deferred learner
+    # records that can sort before OR after the actor records
+    async_ingest: Dict[tuple, List] = {}
+    async_pub: Dict[tuple, float] = {}
+    actor_ids = set()
+    for e in events:
+        k = e.get("event")
+        if k == "async_learner_spans":
+            s = seg_of[id(e)]
+            for row in (e.get("ingests") or []):
+                async_ingest[(s, int(row[5]))] = row
+            for p_ts, ver in (e.get("publishes") or []):
+                async_pub.setdefault((s, int(ver)), float(p_ts))
+        elif k == "async_actor_ep":
+            actor_ids.add(int(e.get("actor") or 0))
+    for a in sorted(actor_ids):
+        out.append({"ph": "M", "name": "thread_name", "pid": TRACE_PID,
+                    "tid": ACTOR_TRACK_BASE + a, "ts": 0.0,
+                    "args": {"name": f"actor{a}"}})
 
     for ev in events:
         kind = ev.get("event")
@@ -375,6 +427,76 @@ def build_trace(events: List[Dict]) -> Dict:
                      ts_us, id=flow_id)
                 push("f", "serve_req", TRACE_TRACKS["serve"], f_ts,
                      id=flow_id, bp="e")
+        elif kind == "async_actor_ep":
+            # one deferred record per actor-episode; every span below
+            # uses the PAYLOAD wall times, not this record's emit ts.
+            # All complete slices ("X") — reconstructed spans from three
+            # concurrent threads must never share a B/E stack.
+            aid = int(ev.get("actor") or 0)
+            tid = ACTOR_TRACK_BASE + aid
+            s = seg_of[id(ev)]
+            ep = ev.get("ep")
+            for c0, c1, ver in (ev.get("chunks") or []):
+                push("X", f"rollout ep{ep}", tid, _us(float(c0), t0),
+                     dur=round(max(float(c1) - float(c0), 0.0) * 1e6, 1),
+                     args={"episode": ep, "version": int(ver)})
+            for t_enq, wait_s, steps, ver, seq in (ev.get("puts") or []):
+                t_enq, wait_s = float(t_enq), max(float(wait_s), 0.0)
+                enq_us = _us(t_enq, t0)
+                # the backpressure wait the put paid, on the actor track
+                push("X", "put", tid, _us(t_enq - wait_s, t0),
+                     dur=round(wait_s * 1e6, 1),
+                     args={"seq": int(seq), "steps": int(steps),
+                           "staleness_wait_s": round(wait_s, 6),
+                           "version": int(ver)})
+                ing = async_ingest.get((s, int(seq)))
+                ing_us = _us(float(ing[0]), t0) if ing else None
+                # queued residency on the channel track: put -> pop
+                push("X", f"block s{seq}", TRACE_TRACKS["channel"],
+                     enq_us,
+                     dur=(round(max(ing_us - enq_us, 0.0), 1)
+                          if ing_us is not None else 0.0),
+                     args={"seq": int(seq), "steps": int(steps),
+                           "staleness_wait_s": round(wait_s, 6),
+                           "version": int(ver)})
+                if ing_us is not None and ing_us >= enq_us:
+                    flow_id += 1
+                    push("s", "chan", tid, enq_us, id=flow_id,
+                         args={"steps": int(steps),
+                               "staleness_wait_s": round(wait_s, 6)})
+                    push("f", "chan", TRACE_TRACKS["learner"], ing_us,
+                         id=flow_id, bp="e")
+            for a_ts, ver in (ev.get("adopts") or []):
+                a_us = _us(float(a_ts), t0)
+                push("i", f"adopt v{int(ver)}", tid, a_us, s="t",
+                     args={"version": int(ver)})
+                # publish -> adopt: one arrow per adopting actor (the
+                # validator balances s/f per flow id, so a version
+                # adopted by N actors gets N independent arrows)
+                p_ts = async_pub.get((s, int(ver)))
+                if p_ts is not None and _us(p_ts, t0) <= a_us:
+                    flow_id += 1
+                    push("s", f"publish v{int(ver)}",
+                         TRACE_TRACKS["learner"], _us(p_ts, t0),
+                         id=flow_id)
+                    push("f", f"publish v{int(ver)}", tid, a_us,
+                         id=flow_id, bp="e")
+        elif kind == "async_learner_spans":
+            ltid = TRACE_TRACKS["learner"]
+            for i0, i1, steps, ver, lag, seq in (ev.get("ingests") or []):
+                push("X", "replay_ingest", ltid, _us(float(i0), t0),
+                     dur=round(max(float(i1) - float(i0), 0.0) * 1e6, 1),
+                     args={"seq": int(seq), "steps": int(steps),
+                           "version": int(ver), "policy_lag": int(lag)})
+            for b0, b1, n in (ev.get("bursts") or []):
+                push("X", f"learn_burst {int(n)}", ltid,
+                     _us(float(b0), t0),
+                     dur=round(max(float(b1) - float(b0), 0.0) * 1e6, 1),
+                     args={"burst": int(n)})
+            for p_ts, ver in (ev.get("publishes") or []):
+                push("i", f"publish v{int(ver)}", ltid,
+                     _us(float(p_ts), t0), s="t",
+                     args={"version": int(ver)})
         # other event kinds (precision, harness_episode, ...) carry no
         # timeline geometry — the report renders them, the trace skips them
 

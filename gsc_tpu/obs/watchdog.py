@@ -36,7 +36,9 @@ class PipelineWatchdog:
                  poll_s: Optional[float] = None,
                  start_paused: bool = False,
                  escalate_after: int = 0,
-                 on_escalate: Optional[Callable[[float], None]] = None):
+                 on_escalate: Optional[Callable[[float], None]] = None,
+                 on_blackbox: Optional[Callable[[str, float],
+                                               None]] = None):
         if budget_s <= 0:
             raise ValueError(f"watchdog budget must be > 0, got {budget_s}")
         self.hub = hub
@@ -50,7 +52,18 @@ class PipelineWatchdog:
         # into it; 0 disables escalation (report-only, the PR 2 behavior).
         self.escalate_after = max(int(escalate_after), 0)
         self.on_escalate = on_escalate
+        # black-box hook (flight recorder): ``on_blackbox(thread, age_s)``
+        # fires once per escalation — the RunObserver wires its
+        # post-mortem dump here so a wedged fleet leaves blackbox.json,
+        # not just a stall line in a stream nobody can read back
+        self.on_blackbox = on_blackbox
         self._escalated = False
+        # fleet coverage: extra per-thread heartbeats (actor0..N, the
+        # learner) watched alongside the main beat.  Each carries its own
+        # stall/escalation state so one wedged actor re-arms
+        # independently of a healthy learner.  name -> state dict
+        self._watched: Dict[str, Dict] = {}
+        self._watched_lock = threading.Lock()
         # poll fast enough to flag a stall well inside one extra budget
         # interval, but never busier than 4 Hz
         self.poll_s = poll_s if poll_s is not None else max(
@@ -73,6 +86,29 @@ class PipelineWatchdog:
         events (e.g. prefetch queue depth)."""
         self._probes[name] = fn
 
+    def watch_thread(self, name: str, budget_s: Optional[float] = None):
+        """Watch one more per-thread heartbeat (fleet coverage: actors,
+        the learner).  The thread must ``hub.beat(name)`` at its own
+        cadence; when it goes quiet past ``budget_s`` (default: the main
+        budget) ONE ``stall`` event fires naming the thread and the
+        phase ``hub.note_thread_phase`` last recorded for it — so a
+        wedged actor reads as ``actor1 stuck in blocked_put``, not as an
+        anonymous missed episode.  Re-arms on the thread's next beat."""
+        self.hub.beat(name)   # arm from registration, like start()
+        with self._watched_lock:
+            self._watched[name] = {
+                "budget_s": float(budget_s) if budget_s else self.budget_s,
+                "stalled": False, "stalled_at_beat": None,
+                "escalated": False}
+
+    def unwatch_thread(self, name: str):
+        with self._watched_lock:
+            self._watched.pop(name, None)
+
+    def unwatch_all_threads(self):
+        with self._watched_lock:
+            self._watched.clear()
+
     def start(self):
         self.hub.beat(self.beat_name)   # arm: age measured from start
         self._thread.start()
@@ -85,6 +121,13 @@ class PipelineWatchdog:
         self._stalled = False
         self._escalated = False
         self._stalled_at_beat = None
+        with self._watched_lock:
+            for name, st in self._watched.items():
+                # paused time never counts toward any thread's budget
+                self.hub.beat(name)
+                st["stalled"] = False
+                st["escalated"] = False
+                st["stalled_at_beat"] = None
         self._paused.clear()
 
     def pause(self):
@@ -122,6 +165,62 @@ class PipelineWatchdog:
                     and age > self.budget_s * (1 + self.escalate_after)):
                 self._escalated = True
                 self._escalate(age)
+            self._poll_watched()
+
+    def _poll_watched(self):
+        """One pass over the fleet's per-thread heartbeats: stall events
+        name the quiet thread + its last phase; continued silence past
+        the escalation horizon triggers the black-box dump (once per
+        stall episode, per thread)."""
+        with self._watched_lock:
+            watched = list(self._watched.items())
+        for name, st in watched:
+            age = self.hub.beat_age(name)
+            if age is None:
+                continue
+            beat = self.hub.beat_time(name)
+            if st["stalled"] and beat != st["stalled_at_beat"]:
+                st["stalled"] = False
+                st["escalated"] = False
+            if age > st["budget_s"] and not st["stalled"]:
+                st["stalled"] = True
+                st["stalled_at_beat"] = beat
+                self.stall_count += 1
+                self._emit_thread_stall(name, age, st["budget_s"])
+            if (st["stalled"] and not st["escalated"]
+                    and age > st["budget_s"] * (1 + max(
+                        self.escalate_after, 1))):
+                st["escalated"] = True
+                self._blackbox(name, age)
+
+    def _emit_thread_stall(self, name: str, age: float, budget_s: float):
+        fields: Dict[str, object] = {
+            "thread": name,
+            "age_s": round(age, 3),
+            "budget_s": budget_s,
+            "last_phase": self.hub.thread_phase(name),
+            "heartbeats": self.hub.beat_ages(),
+            "thread_phases": self.hub.thread_phases(),
+        }
+        for pname, fn in self._probes.items():
+            try:
+                fields[pname] = fn()
+            except Exception as e:
+                fields[pname] = f"probe-error: {e!r}"
+        self.hub.counter("stalls")
+        self.hub.counter("thread_stalls", thread=name)
+        self.hub.event("stall", **fields)
+
+    def _blackbox(self, thread: str, age: float):
+        cb = self.on_blackbox
+        self.hub.counter("blackbox_dumps")
+        if cb is not None:
+            try:
+                cb(thread, age)
+            except Exception as e:   # the dump failing must not kill the
+                # monitor — the stall evidence is already in the stream
+                self.hub.event("blackbox_error", thread=thread,
+                               error=repr(e))
 
     def _escalate(self, age: float):
         """The stall outlived ``escalate_after`` extra budget periods: act.
@@ -140,6 +239,9 @@ class PipelineWatchdog:
             except Exception as e:   # an escalation that faults must not
                 # kill the monitor thread — the stall evidence survives
                 self.hub.event("escalation_error", error=repr(e))
+        # the main pipeline going quiet past its escalation horizon is a
+        # post-mortem moment too — same dump the wedged-thread path gets
+        self._blackbox(self.beat_name, age)
 
     def _emit_stall(self, age: float):
         phase, done = self.hub.last_phase

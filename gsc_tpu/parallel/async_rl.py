@@ -131,7 +131,9 @@ class _Channel:
     """Bounded actor->learner conduit of device-resident transition
     blocks.  ``put`` blocks while the outstanding (produced - ingested)
     step backlog would exceed ``max_outstanding`` — that wait IS the
-    staleness backpressure."""
+    staleness backpressure.  Every block carries a global FIFO ``seq``
+    (the flight recorder's put->pop flow-arrow key) plus its enqueue
+    wall time and the backpressure wait it paid."""
 
     def __init__(self, max_outstanding: int):
         self.max_outstanding = int(max_outstanding)
@@ -140,28 +142,38 @@ class _Channel:
         self.produced_steps = 0
         self.ingested_steps = 0
         self.max_observed_lag = 0
+        self._seq = 0
         self._stop = False
 
     def outstanding(self) -> int:
         return self.produced_steps - self.ingested_steps
 
-    def put(self, block, steps: int, version: int, timer=None) -> bool:
-        """Enqueue one block; returns False when the run is stopping."""
+    def put(self, block, steps: int, version: int, timer=None,
+            on_wait: Optional[Callable[[float], None]] = None) -> int:
+        """Enqueue one block; returns its seq (>=1, truthy), or 0 when
+        the run is stopping.  ``on_wait(seconds)`` receives each
+        backpressure slice (the per-actor idle the flight recorder
+        attributes)."""
         with self._cond:
             while (not self._stop and self._blocks
                    and self.outstanding() + steps > self.max_outstanding):
                 t0 = time.perf_counter()
                 self._cond.wait(0.05)
+                waited = time.perf_counter() - t0
                 if timer is not None:
-                    timer.add("actor_idle", time.perf_counter() - t0)
+                    timer.add("actor_idle", waited)
+                if on_wait is not None:
+                    on_wait(waited)
             if self._stop:
-                return False
-            self._blocks.append((block, int(steps), int(version)))
+                return 0
+            self._seq += 1
+            self._blocks.append((block, int(steps), int(version),
+                                 self._seq))
             self.produced_steps += int(steps)
             self.max_observed_lag = max(self.max_observed_lag,
                                         self.outstanding())
             self._cond.notify_all()
-            return True
+            return self._seq
 
     def get_nowait(self):
         with self._cond:
@@ -200,6 +212,70 @@ class _ActorPolicy:
         self.params = jax.tree_util.tree_unflatten(self.treedef,
                                                    list(leaves))
         self.policy_version = int(version)
+
+
+class _FlightLedger:
+    """Host-side flight recorder for one ``run_async``: actor threads and
+    the learner append plain tuples (one lock, one list append — no
+    device syncs, no event emission on the dispatch path); the run end
+    flushes everything as compact deferred events (``async_actor_ep``,
+    ``async_learner_spans``) that :func:`gsc_tpu.obs.trace.build_trace`
+    reconstructs per-actor / channel / learner tracks plus put->pop and
+    publish->adopt flow arrows from.  Timestamps are ``time.time()``
+    (the event stream's wall base, so the reconstructed spans land on
+    the same axis as every other track).
+
+    Row shapes (positional, kept terse because they land in JSONL):
+
+    - actor episode: ``{ep, actor, chunks: [[t0, t1, ver], ...],
+      puts: [[t_enq, wait_s, steps, ver, seq], ...],
+      adopts: [[ts, ver], ...]}``
+    - ingest: ``[t0, t1, steps, ver, lag, seq]``
+    - burst: ``[t0, t1, n]`` / publish: ``[ts, ver]``
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.actor_eps: List[Dict] = []
+        self.ingests: List[List] = []
+        self.bursts: List[List] = []
+        self.publishes: List[List] = []
+
+    def note_actor_episode(self, rec: Dict):
+        with self._lock:
+            self.actor_eps.append(rec)
+
+    def note_ingest(self, t0, t1, steps, version, lag, seq):
+        with self._lock:
+            self.ingests.append([round(t0, 6), round(t1, 6), int(steps),
+                                 int(version), int(lag), int(seq)])
+
+    def note_burst(self, t0, t1, n):
+        with self._lock:
+            self.bursts.append([round(t0, 6), round(t1, 6), int(n)])
+
+    def note_publish(self, ts, version):
+        with self._lock:
+            self.publishes.append([round(ts, 6), int(version)])
+
+    def flush_deferred(self, hub, chunk_rows: int = 256):
+        """Emit the deferred event records (run end, learner thread).
+        Learner spans chunk at ``chunk_rows`` rows per event so one
+        record never outgrows the rotating sink's line budget."""
+        with self._lock:
+            actor_eps = list(self.actor_eps)
+            ingests = list(self.ingests)
+            bursts = list(self.bursts)
+            publishes = list(self.publishes)
+        for rec in actor_eps:
+            hub.event("async_actor_ep", **rec)
+        total = max(len(ingests), len(bursts), len(publishes))
+        parts = max(1, -(-total // chunk_rows))
+        for p in range(parts):
+            lo, hi = p * chunk_rows, (p + 1) * chunk_rows
+            hub.event("async_learner_spans", part=p, parts=parts,
+                      ingests=ingests[lo:hi], bursts=bursts[lo:hi],
+                      publishes=publishes[lo:hi])
 
 
 @dataclass
@@ -286,8 +362,18 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
         return range(start_episode + aid, episodes, n_actors)
 
     policy_lags: List[int] = []
+    # flight recorder: the ledger only exists when the hub keeps series
+    # history — with it off, run_async emits not one extra event and the
+    # stream stays byte-identical to the pre-recorder pipeline
+    ledger = (_FlightLedger() if hub is not None
+              and getattr(hub, "series_store", None) is not None else None)
+    # per-actor backpressure wait accumulators (each slot written by its
+    # own actor thread only) — the live actor_idle_frac probes read them
+    actor_wait_s = [0.0] * n_actors
+    learner_idle_acc = [0.0]
 
     def actor_loop(aid: int):
+        tname = f"actor{aid}"
         policy = _ActorPolicy(treedef)
         watcher = VersionWatcher(None, policy, hub=hub,
                                  publisher=publisher)
@@ -298,6 +384,13 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                                                        1000 + aid))
         first = True
         n_chunks = episode_steps // chunk
+
+        def on_wait(waited: float):
+            # one slot per actor, written only by this thread
+            actor_wait_s[aid] += waited
+            if hub is not None:
+                hub.beat(tname)   # a backpressured actor is NOT wedged
+
         try:
             for ep in actor_episodes(aid):
                 if stop_event.is_set():
@@ -318,30 +411,60 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                         scratch = pddpg.init_buffers(one_obs,
                                                      capacity=chunk)
                     chunk_stats = []
+                    chunks = []
+                    puts = []
+                    adopts = []
                     for c in range(n_chunks):
                         # between-dispatch weight adoption: poll_once
                         # runs HERE, in the actor's own thread, so a
                         # swap can never land mid-batch (the fleet's
                         # flush-lock discipline, by construction)
+                        if hub is not None:
+                            hub.note_thread_phase(tname, "adopt")
                         if watcher.poll_once():
                             a_state = a_state.replace(
                                 actor_params=policy.params)
+                            if ledger is not None:
+                                adopts.append([round(time.time(), 6),
+                                               policy.policy_version])
                         start = jnp.int32(ep * episode_steps + c * chunk)
+                        if hub is not None:
+                            hub.note_thread_phase(tname, "dispatch")
+                        t_roll = time.time()
                         with (timer.phase("actor_dispatch") if timer
                               else _noop()):
                             (a_state, scratch, env_states, obs,
                              stats) = pddpg.rollout_episodes(
                                 a_state, scratch, env_states, obs,
                                 topo, traffic, start, chunk)
+                        if ledger is not None:
+                            chunks.append([round(t_roll, 6),
+                                           round(time.time(), 6),
+                                           policy.policy_version])
                         chunk_stats.append(stats)
-                        if not channel.put(scratch.data, B * chunk,
-                                           policy.policy_version,
-                                           timer=timer):
+                        if hub is not None:
+                            hub.note_thread_phase(tname, "blocked_put")
+                        wait0 = actor_wait_s[aid]
+                        seq = channel.put(scratch.data, B * chunk,
+                                          policy.policy_version,
+                                          timer=timer, on_wait=on_wait)
+                        if not seq:
                             return
+                        if ledger is not None:
+                            puts.append([
+                                round(time.time(), 6),
+                                round(actor_wait_s[aid] - wait0, 6),
+                                B * chunk, policy.policy_version, seq])
+                        if hub is not None:
+                            hub.beat(tname)   # liveness = chunk cadence
                 finally:
                     if lock is not None:
                         lock.release()
                         first = False
+                if ledger is not None:
+                    ledger.note_actor_episode({
+                        "ep": ep, "actor": aid, "chunks": chunks,
+                        "puts": puts, "adopts": adopts})
                 with results_lock:
                     results.append({"episode": ep, "actor": aid,
                                     "policy_version":
@@ -364,6 +487,20 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
     t_start = time.perf_counter()
     for t in threads:
         t.start()
+    if hub is not None:
+        # live idle-fraction probes: a mid-run /metrics scrape reads the
+        # CURRENT fractions, not the last event-writer sample.  Replaced
+        # by plain final gauges (and dropped) at run end.
+        def _idle_probe(slot, acc):
+            def probe():
+                wall = time.perf_counter() - t_start
+                return acc[slot] / wall if wall > 0 else 0.0
+            return probe
+        for a in range(n_actors):
+            hub.live_gauge("actor_idle_frac",
+                           _idle_probe(a, actor_wait_s), actor=a)
+        hub.live_gauge("learner_idle_frac",
+                       _idle_probe(0, learner_idle_acc))
 
     def allowance() -> int:
         return int(channel.ingested_steps * cfg.learn_ratio
@@ -379,6 +516,8 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
             publisher.publish(params, meta={"burst": bursts,
                                             "episodes": len(drained)})
             publishes += 1
+            if ledger is not None:
+                ledger.note_publish(time.time(), publisher.version)
         else:
             log.warning("non-finite actor params at burst %d — publish "
                         "skipped so a poisoned state never reaches the "
@@ -442,15 +581,31 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
             while item is not None:
                 items.append(item)
                 item = channel.get_nowait()
-            for block, steps, version in items:
+            for block, steps, version, seq in items:
+                if hub is not None:
+                    hub.note_thread_phase("learner", "ingest")
+                t_ing = time.time()
                 with (timer.phase("replay_ingest") if timer
                       else _noop()):
                     buffers = replay_ingest(buffers, block)
                 lag = publisher.version - version
                 policy_lags.append(lag)
+                outstanding = channel.outstanding()
+                if ledger is not None:
+                    ledger.note_ingest(t_ing, time.time(), steps, version,
+                                  lag, seq)
                 if hub is not None:
+                    # gauges keep the PR 16 last-value semantics; the
+                    # histograms add mid-run p50/p99/max to /metrics and
+                    # the rings add history to /series — same samples,
+                    # three read paths
                     hub.gauge("policy_lag", lag)
-                    hub.gauge("replay_lag", channel.outstanding())
+                    hub.gauge("replay_lag", outstanding)
+                    hub.observe("policy_lag", lag)
+                    hub.observe("replay_lag", outstanding)
+                    hub.series("policy_lag", lag)
+                    hub.series("replay_lag", outstanding)
+                    hub.beat("learner")
                 progressed = True
                 check_stop()
             drain_results()
@@ -459,11 +614,18 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                 last_ckpt = len(drained)
                 checkpoint_fn(state, buffers, len(drained))
             if bursts < allowance():
+                if hub is not None:
+                    hub.note_thread_phase("learner", "learn_burst")
+                t_burst = time.time()
                 with (timer.phase("learn_dispatch") if timer
                       else _noop()):
                     state, last_metrics = pddpg.learn_burst(state,
                                                             buffers)
                 bursts += 1
+                if ledger is not None:
+                    ledger.note_burst(t_burst, time.time(), bursts)
+                if hub is not None:
+                    hub.beat("learner")
                 if cfg.throttle_s:
                     time.sleep(cfg.throttle_s)
                 if on_burst is not None:
@@ -473,10 +635,15 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
             if not progressed:
                 if not actors_alive() and channel.outstanding() == 0:
                     break
+                if hub is not None:
+                    hub.note_thread_phase("learner", "idle")
+                    hub.beat("learner")   # an idle learner is not wedged
                 t0 = time.perf_counter()
                 channel.wait_for_data(cfg.idle_wait_s)
+                waited = time.perf_counter() - t0
+                learner_idle_acc[0] += waited
                 if timer is not None:
-                    timer.add("learner_idle", time.perf_counter() - t0)
+                    timer.add("learner_idle", waited)
     finally:
         stop_event.set()
         channel.stop()
@@ -486,10 +653,16 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
     # graceful drain: nothing in flight, nothing lost, no future hung
     jax.block_until_ready((state, buffers))
     wall = time.perf_counter() - t_start
-    idle_s = 0.0
+    idle_s = learner_idle_acc[0]
     if timer is not None:
         idle_s = (timer.summary().get("learner_idle")
-                  or {}).get("total_s", 0.0)
+                  or {}).get("total_s", idle_s)
+    lag_sorted = sorted(policy_lags)
+    pct = lambda q: (lag_sorted[min(int(q * len(lag_sorted)),  # noqa: E731
+                                    len(lag_sorted) - 1)]
+                     if lag_sorted else 0)
+    actor_fracs = [round(w / wall, 4) if wall > 0 else 0.0
+                   for w in actor_wait_s]
     info = {
         "actors": n_actors,
         "episodes_drained": len(drained),
@@ -505,13 +678,27 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
         "policy_lag_max": max(policy_lags) if policy_lags else 0,
         "policy_lag_mean": (round(float(np.mean(policy_lags)), 4)
                             if policy_lags else 0.0),
+        "policy_lag_p50": pct(0.50),
+        "policy_lag_p99": pct(0.99),
         "wall_s": round(wall, 4),
         "learner_idle_s": round(idle_s, 4),
         "learner_idle_frac": round(idle_s / wall, 4) if wall > 0 else 0.0,
+        "actor_idle_fracs": actor_fracs,
+        "actor_idle_frac": max(actor_fracs) if actor_fracs else 0.0,
     }
     if hub is not None:
+        # live probes made way for final plain gauges (a post-run scrape
+        # must read the run's verdict, not a stale wall-clock fraction)
+        hub.drop_live_gauge("learner_idle_frac")
         hub.gauge("learner_idle_frac", info["learner_idle_frac"])
+        hub.series("learner_idle_frac", info["learner_idle_frac"])
+        for a, frac in enumerate(actor_fracs):
+            hub.drop_live_gauge("actor_idle_frac", actor=a)
+            hub.gauge("actor_idle_frac", frac, actor=a)
+            hub.series("actor_idle_frac", frac, actor=a)
         hub.gauge("actor_policy_version", publisher.version)
+        if ledger is not None:
+            ledger.flush_deferred(hub)
     return AsyncResult(state=state, buffers=buffers,
                        episodes=drained, info=info)
 

@@ -440,6 +440,7 @@ class FleetDispatcher:
         self.burn_refresh_s = float(burn_refresh_s)
         self._burn_cache: Tuple[float, Optional[float]] = (0.0, None)
         self._burn_lock = threading.Lock()
+        self._series_ts = 0.0   # last flight-recorder sample (monotonic)
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "FleetDispatcher":
@@ -456,6 +457,9 @@ class FleetDispatcher:
         return self
 
     def close(self):
+        # one forced ring sample on the way out, so even a short load
+        # leaves the fleet's final queue/occupancy picture in history
+        self.sample_series(force=True)
         for w in self.workers:
             w.close()
         if self.spr is not None:
@@ -495,6 +499,7 @@ class FleetDispatcher:
         tier on sustained budget burn (proactive) or a full worker queue
         (reactive) — the fleet only rejects when there is nowhere left
         to put the request."""
+        self.sample_series()
         worker = min(self.workers, key=lambda w: w.queue_depth)
         if self.spr is not None and self._should_brownout(worker):
             self._count_brownout("slo_burn")
@@ -524,7 +529,10 @@ class FleetDispatcher:
     def _fleet_burn(self) -> Optional[float]:
         """Max error-budget burn rate across the workers' SLO engines,
         refreshed at ``burn_refresh_s`` cadence (an engine snapshot walks
-        its rolling window — too heavy per submit)."""
+        its rolling window — too heavy per submit).  Each refresh also
+        feeds the flight-recorder rings (:meth:`sample_series`) — the
+        serving fleet's history rides the existing rate limit, never a
+        per-submit cost."""
         now = time.monotonic()
         with self._burn_lock:
             ts, burn = self._burn_cache
@@ -535,12 +543,53 @@ class FleetDispatcher:
                 engine = getattr(w, "slo_engine", None)
                 if engine is None:
                     continue
-                b = engine.snapshot().get("burn_rate")
+                snap = engine.snapshot()
+                b = snap.get("burn_rate")
                 if b is not None:
                     burns.append(b)
+                self._series_from_snapshot(w, snap)
             burn = max(burns) if burns else None
             self._burn_cache = (now, burn)
+            if burn is not None and self.hub is not None:
+                self.hub.series("serve_burn_rate", burn)
             return burn
+
+    def _series_from_snapshot(self, worker, snap: Dict):
+        """One worker's ring points from an engine snapshot it already
+        paid for: pad waste per bucket + overall (``series`` no-ops on a
+        history-free hub, so this is free when the recorder is off)."""
+        if self.hub is None:
+            return
+        pad = snap.get("pad_waste")
+        if pad is not None:
+            self.hub.series("serve_pad_waste", pad, worker=worker.worker)
+        for bucket, rec in (snap.get("per_bucket") or {}).items():
+            bpad = rec.get("pad_waste")
+            if bpad is not None:
+                self.hub.series("serve_pad_waste", bpad,
+                                worker=worker.worker, bucket=bucket)
+
+    def sample_series(self, force: bool = False):
+        """Feed the flight-recorder rings one fleet sample: per-worker
+        queue depth and per-bucket batch occupancy, plus the SLO-derived
+        points (pad waste, burn) via :meth:`_fleet_burn`.  Called from
+        :meth:`submit` but self-rate-limited to ``burn_refresh_s`` — the
+        dispatch path only ever pays an attribute check and a clock
+        read.  No-op without a hub or without a series window."""
+        if self.hub is None \
+                or getattr(self.hub, "series_store", None) is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._series_ts < self.burn_refresh_s:
+            return
+        self._series_ts = now
+        for w in self.workers:
+            self.hub.series("serve_queue_depth", w.queue_depth,
+                            worker=w.worker)
+            for bucket, n in sorted(w._occupancy.items()):
+                self.hub.series("serve_occupancy", n, worker=w.worker,
+                                bucket=bucket)
+        self._fleet_burn()
 
     # --------------------------------------------------------------- stats
     @property

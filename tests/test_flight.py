@@ -1,0 +1,425 @@
+"""Flight-recorder tests — the PR-17 observability layer.
+
+Covers: the SeriesStore ring semantics hand-checked (retention window,
+drop-oldest overflow, bare-name/`since` queries, tag fan-out, timestamp
+rounding) and its thread-safety under concurrent writers, the hub's
+``series()`` gate (no-op without a window — the byte-parity contract's
+first half), the ``/series`` endpoint round-trip against the in-process
+ring (plus its 400/404 error contract), one REAL 2-actor
+``Trainer.train_async`` run whose ``series.json`` last points match the
+final ``metrics.json`` snapshot and whose event stream reconstructs a
+strict-validator-clean Chrome trace with per-actor tracks and balanced
+publish→adopt flows, the fleet watchdog naming a deliberately wedged
+actor (and escalating into the black-box hook), the ``blackbox.json``
+schema on the direct, RunObserver, error-close and SIGTERM-preempt
+paths, and ledger-off bit-parity (a window-0 hub changes not one bit of
+the replay rings and emits zero flight events).
+"""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gsc_tpu.obs import (BLACKBOX_SCHEMA_VERSION, SERIES_SCHEMA_VERSION,
+                         ListSink, MetricsHub, SeriesStore)
+
+pytestmark = pytest.mark.flight
+
+
+# ------------------------------------------------------- series rings
+def test_series_ring_retention_hand_computed():
+    """Window-4 ring under 6 appends keeps exactly the last 4 points,
+    oldest-first — hand-computed, plus the bare-name/`since` query
+    contract, tag fan-out and the 3-decimal timestamp rounding."""
+    store = SeriesStore(window=4)
+    for i in range(6):
+        store.add_point("lag", float(i), ts=100.0 + i)
+    assert store.query(name="lag") == {
+        "gsc_lag": [[102.0, 2.0], [103.0, 3.0], [104.0, 4.0], [105.0, 5.0]]}
+    assert store.last("lag") == 5.0
+    assert store.query(name="lag", since=104.0)["gsc_lag"] == \
+        [[104.0, 4.0], [105.0, 5.0]]
+    # a bare name the store never saw yields an empty document
+    assert store.query(name="nope") == {}
+    # timestamps land rounded to ms like the rest of the obs layer
+    store.add_point("lag", 9.0, ts=200.000499)
+    assert store.query(name="lag")["gsc_lag"][-1] == [200.0, 9.0]
+    # one bare name fans out to one ring per tag set; base tags fold
+    # into the flat exposition key in sorted order
+    tagged = SeriesStore(window=8, base_tags={"run": "r"})
+    tagged.add_point("occ", 1.0, ts=1.0, replica=0)
+    tagged.add_point("occ", 2.0, ts=1.0, replica=1)
+    q = tagged.query(name="occ")
+    assert set(q) == {'gsc_occ{replica="0",run="r"}',
+                      'gsc_occ{replica="1",run="r"}'}
+    assert tagged.last("occ", replica=1) == 2.0
+    assert tagged.point_count() == 2
+    assert tagged.names() == sorted(q)
+    # document(): the schema-versioned payload series.json and /series share
+    doc = store.document(run="r1")
+    assert doc["schema_version"] == SERIES_SCHEMA_VERSION
+    assert doc["run"] == "r1" and doc["window"] == 4
+    assert doc["series"] == store.query()
+    with pytest.raises(ValueError, match="window"):
+        SeriesStore(window=0)
+
+
+def test_series_ring_thread_safety():
+    """4 writer threads × 500 appends into one store: every per-thread
+    ring holds exactly its window of the newest points, nothing torn,
+    nothing cross-ring."""
+    store = SeriesStore(window=128)
+    n = 500
+
+    def feed(tid):
+        for i in range(n):
+            store.add_point("m", float(i), ts=float(i), thread=tid)
+
+    threads = [threading.Thread(target=feed, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    q = store.query(name="m")
+    assert len(q) == 4
+    for tid in range(4):
+        pts = q[f'gsc_m{{thread="{tid}"}}']
+        assert pts == [[float(i), float(i)] for i in range(n - 128, n)]
+    assert store.point_count() == 4 * 128
+
+
+def test_hub_series_gate():
+    """The hub's series() is a no-op without a window (feed sites never
+    gate themselves) and a plain ring append with one."""
+    hub = MetricsHub()
+    assert hub.series_store is None
+    hub.series("x", 1.0)   # must not raise, must not create state
+    assert hub.series_store is None
+    live = MetricsHub(tags={"run": "h"}, series_window=4)
+    live.series("x", 1.0, ts=5.0)
+    assert live.series_store.last("x") == 1.0
+    # ring keys inherit the hub's base tags
+    assert list(live.series_store.query(name="x")) == ['gsc_x{run="h"}']
+
+
+# -------------------------------------------------------- /series endpoint
+def test_series_endpoint_roundtrip():
+    """GET /series returns exactly the in-process ring document;
+    name=/since= filter server-side; unparseable since is a 400; a hub
+    without a series window serves 404."""
+    from gsc_tpu.obs.endpoint import MetricsEndpoint
+    hub = MetricsHub(tags={"run": "ep"}, series_window=16)
+    for i in range(5):
+        hub.series("qdepth", float(i), ts=1000.0 + i)
+        hub.series("burn", 2.0 * i, ts=1000.0 + i, bucket="b0")
+    ep = MetricsEndpoint(hub, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{ep.port}"
+        doc = json.loads(urllib.request.urlopen(base + "/series").read())
+        assert doc["schema_version"] == SERIES_SCHEMA_VERSION
+        assert doc["run"] == "ep"
+        assert doc["series"] == \
+            hub.series_store.document(run="ep")["series"]
+        doc2 = json.loads(urllib.request.urlopen(
+            base + "/series?name=qdepth&since=1002").read())
+        assert list(doc2["series"]) == ['gsc_qdepth{run="ep"}']
+        assert doc2["series"]['gsc_qdepth{run="ep"}'] == \
+            [[1002.0, 2.0], [1003.0, 3.0], [1004.0, 4.0]]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/series?since=yesterday")
+        assert err.value.code == 400
+    finally:
+        ep.stop()
+    bare = MetricsEndpoint(MetricsHub(), port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{bare.port}/series")
+        assert err.value.code == 404
+    finally:
+        bare.stop()
+
+
+# ------------------------------------------------------- fleet watchdog
+def test_watchdog_stall_names_wedged_actor():
+    """One deliberately wedged actor among beating peers: the stall
+    event names actor1 and the blocked_put phase it is stuck in, and
+    continued silence past the escalation horizon fires the black-box
+    hook for that thread."""
+    from gsc_tpu.obs.watchdog import PipelineWatchdog
+    sink = ListSink()
+    hub = MetricsHub()
+    hub.add_sink(sink)
+    dumps = []
+    wd = PipelineWatchdog(
+        hub, budget_s=30.0, poll_s=0.02,
+        on_blackbox=lambda thread, age: dumps.append((thread, age)))
+    wd.start()
+    try:
+        wd.watch_thread("learner", budget_s=5.0)
+        wd.watch_thread("actor0", budget_s=5.0)
+        wd.watch_thread("actor1", budget_s=0.05)
+        hub.note_thread_phase("actor0", "dispatch")
+        hub.note_thread_phase("actor1", "blocked_put")
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not dumps:
+            # healthy peers keep beating; actor1 never does again
+            hub.beat("actor0")
+            hub.beat("learner")
+            time.sleep(0.02)
+    finally:
+        wd.stop()
+    stalls = [r for r in sink.records if r.get("event") == "stall"]
+    assert stalls, "wedged actor produced no stall event"
+    assert all(s["thread"] == "actor1" for s in stalls)
+    s = stalls[0]
+    assert s["last_phase"] == "blocked_put"
+    assert s["budget_s"] == 0.05 and s["age_s"] > 0.05
+    assert s["thread_phases"]["actor0"] == "dispatch"
+    assert "actor1" in s["heartbeats"]
+    # escalation horizon (budget * (1 + max(escalate_after, 1))) passed:
+    # the dump hook fired once, for the wedged thread
+    assert dumps and dumps[0][0] == "actor1" and dumps[0][1] > 0.1
+    assert hub.get_counter("thread_stalls", thread="actor1") == 1
+    assert hub.get_counter("blackbox_dumps") == 1
+
+
+# ------------------------------------------------------- black-box dumps
+def test_write_blackbox_schema(tmp_path):
+    """The post-mortem document: schema version, the series tail inside
+    the window (older points excluded), the event tail, heartbeat ages,
+    thread phases and extra fields — and the store-less degenerate form."""
+    from gsc_tpu.obs.series import write_blackbox
+    store = SeriesStore(window=8)
+    now = time.time()
+    store.add_point("lag", 3.0, ts=now - 1.0)
+    store.add_point("lag", 9.0, ts=now - 300.0)   # outside the 30s window
+    path = write_blackbox(
+        str(tmp_path / "bb.json"), "test_reason", store=store,
+        events=[{"event": "stall", "thread": "actor1"}], window_s=30.0,
+        heartbeats={"actor1": 2.5},
+        thread_phases={"actor1": "blocked_put"}, run="r",
+        extra={"age_s": 1.2})
+    doc = json.load(open(path))
+    assert doc["schema_version"] == BLACKBOX_SCHEMA_VERSION
+    assert doc["reason"] == "test_reason" and doc["run"] == "r"
+    assert doc["window_s"] == 30.0
+    assert [v for _, v in doc["series"]["gsc_lag"]] == [3.0]
+    assert doc["events"] == [{"event": "stall", "thread": "actor1"}]
+    assert doc["heartbeats"] == {"actor1": 2.5}
+    assert doc["thread_phases"] == {"actor1": "blocked_put"}
+    assert doc["age_s"] == 1.2
+    # a run with the recorder off still leaves heartbeats on a crash
+    bare = json.load(open(write_blackbox(str(tmp_path / "bb2.json"), "r2")))
+    assert bare["series"] == {} and bare["events"] == []
+    assert bare["schema_version"] == BLACKBOX_SCHEMA_VERSION
+
+
+def test_run_observer_blackbox_and_error_close(tmp_path):
+    """RunObserver.write_blackbox captures the live rings + the pending
+    event tail + fleet heartbeats; an error-status close() rewrites the
+    dump with the run_end reason and still lands series.json."""
+    from gsc_tpu.obs import RunObserver
+    obs = RunObserver(str(tmp_path / "o"), run_id="bb", series_window=8,
+                      compile_events=False)
+    obs.start(meta={"episodes": 1})
+    obs.hub.series("lag", 4.0)
+    obs.hub.beat("actor0")
+    obs.hub.note_thread_phase("actor0", "dispatch")
+    doc = json.load(open(obs.write_blackbox(reason="manual",
+                                            extra={"note": "x"})))
+    assert doc["reason"] == "manual" and doc["note"] == "x"
+    assert any(k.startswith("gsc_lag") for k in doc["series"])
+    # the TailSink caught the run_start event for the pending tail
+    assert any(e.get("event") == "run_start" for e in doc["events"])
+    assert "actor0" in doc["heartbeats"]
+    assert doc["thread_phases"]["actor0"] == "dispatch"
+    obs.close(status="error")
+    doc = json.load(open(obs.blackbox_path))
+    assert doc["reason"] == "run_end:error"
+    series_doc = json.load(open(obs.series_path))
+    assert series_doc["schema_version"] == SERIES_SCHEMA_VERSION
+    assert series_doc["run"] == "bb"
+
+
+# --------------------------------------------------- real 2-actor run e2e
+@pytest.fixture(scope="module")
+def trainer_stack():
+    """ONE compiled tiny stack shared by both train_async tests below
+    (setup re-traces every jitted entry point — the expensive part)."""
+    from tests.test_agent import make_driver, make_stack
+    env, agent, topo, traffic = make_stack()
+    driver = make_driver(env, agent, topo, traffic)
+    return env, agent, driver
+
+
+@pytest.fixture(scope="module")
+def flight_run(trainer_stack, tmp_path_factory):
+    """One REAL 2-actor Trainer.train_async run under a series-window
+    observer — the artifact set (series.json, events.jsonl,
+    metrics.json) the e2e assertions below read."""
+    from gsc_tpu.agents.trainer import Trainer
+    from gsc_tpu.obs import RunObserver
+    env, agent, driver = trainer_stack
+    tmp = tmp_path_factory.mktemp("flight")
+    obs = RunObserver(str(tmp / "obs"), run_id="flightrun",
+                      series_window=64)
+    obs.start(meta={"episodes": 3})
+    tr = Trainer(env, driver, agent, seed=0, result_dir=str(tmp), obs=obs)
+    tr.train_async(episodes=3, num_replicas=2, chunk=2, actor_threads=2)
+    obs.close()
+    return tmp / "obs", tr
+
+
+def test_async_run_series_json_matches_snapshot(flight_run):
+    """series.json from a real async run: schema-versioned, and the last
+    ring point of every fed metric equals the final metrics.json gauge
+    (the rings ride the same values at the same instants)."""
+    run_dir, tr = flight_run
+    assert tr.completed_episodes == 3
+    doc = json.load(open(run_dir / "series.json"))
+    assert doc["schema_version"] == SERIES_SCHEMA_VERSION
+    assert doc["run"] == "flightrun" and doc["window"] == 64
+    series = doc["series"]
+    assert len(series) >= 3
+    snap = json.load(open(run_dir / "metrics.json"))["metrics"]
+    matched = [n for n, pts in series.items()
+               if n in snap and snap[n] == pytest.approx(pts[-1][1])]
+    assert len(matched) >= 3, (sorted(series), sorted(snap))
+    # every shared name agrees — history never drifts from the snapshot
+    for n, pts in series.items():
+        if n in snap:
+            assert snap[n] == pytest.approx(pts[-1][1]), n
+    # the async verdict metrics carry history, not just last values
+    for want in ("gsc_sps{", "gsc_episode{", "gsc_learner_idle_frac{",
+                 "gsc_actor_idle_frac{"):
+        assert any(k.startswith(want) for k in series), want
+    # per-ring timestamps are monotone nondecreasing (oldest first)
+    for pts in series.values():
+        assert all(a[0] <= b[0] for a, b in zip(pts, pts[1:]))
+
+
+def test_async_run_trace_validator_clean(flight_run):
+    """The deferred flight ledger reconstructs a strict-validator-clean
+    trace: per-actor tracks with rollout/put spans, channel residency
+    slices with put→pop flows, learner ingest/burst spans, and balanced
+    publish→adopt flow arrows."""
+    from gsc_tpu.obs.trace import (ACTOR_TRACK_BASE, TRACE_TRACKS,
+                                   build_trace, read_events,
+                                   validate_trace)
+    run_dir, _ = flight_run
+    events = read_events(str(run_dir / "events.jsonl"))
+    actor_eps = [e for e in events if e.get("event") == "async_actor_ep"]
+    assert actor_eps, "flight ledger emitted no actor records"
+    assert any(e.get("event") == "async_learner_spans" for e in events)
+    # static round-robin episode assignment: 3 episodes on 2 actors
+    # always exercises both actor tracks
+    assert {int(e["actor"]) for e in actor_eps} == {0, 1}
+    trace = build_trace(events)
+    assert validate_trace(trace) == []
+    tev = trace["traceEvents"]
+    names = {e["args"]["name"] for e in tev
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"actor0", "actor1"} <= names
+    rollouts = [e for e in tev
+                if e["ph"] == "X" and e["name"].startswith("rollout ep")]
+    assert {e["tid"] for e in rollouts} == {ACTOR_TRACK_BASE,
+                                            ACTOR_TRACK_BASE + 1}
+    # channel residency slices + put→pop flow arrows land on the conduit
+    assert any(e["ph"] == "X" and e["name"].startswith("block s")
+               and e["tid"] == TRACE_TRACKS["channel"] for e in tev)
+    chan_s = sum(1 for e in tev if e["ph"] == "s" and e["name"] == "chan")
+    chan_f = sum(1 for e in tev if e["ph"] == "f" and e["name"] == "chan")
+    assert chan_s == chan_f >= 1
+    ltid = TRACE_TRACKS["learner"]
+    assert any(e["ph"] == "X" and e["name"] == "replay_ingest"
+               and e["tid"] == ltid for e in tev)
+    assert any(e["ph"] == "X" and e["name"].startswith("learn_burst")
+               and e["tid"] == ltid for e in tev)
+    assert any(e["ph"] == "i" and e["name"].startswith("publish v")
+               and e["tid"] == ltid for e in tev)
+    # publish→adopt arrows: one s/f pair per (version, adopting actor) —
+    # balance is the contract (adoption count is scheduling-dependent)
+    pub_s = sum(1 for e in tev
+                if e["ph"] == "s" and e["name"].startswith("publish v"))
+    pub_f = sum(1 for e in tev
+                if e["ph"] == "f" and e["name"].startswith("publish v"))
+    assert pub_s == pub_f
+
+
+def test_train_async_sigterm_writes_blackbox(trainer_stack, tmp_path):
+    """The PR 5 recovery path: a SIGTERM-triggered preemption of
+    train_async leaves blackbox.json tagged with the signal, and a
+    preempted-status close does not overwrite it."""
+    from gsc_tpu.agents.trainer import Trainer
+    from gsc_tpu.obs import RunObserver
+    from gsc_tpu.resilience import PreemptionGuard
+    env, agent, driver = trainer_stack
+    obs = RunObserver(str(tmp_path / "obs"), run_id="preemptrun",
+                      series_window=16, compile_events=False)
+    obs.start(meta={"episodes": 5})
+    tr = Trainer(env, driver, agent, seed=0, result_dir=str(tmp_path),
+                 obs=obs)
+    with PreemptionGuard() as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not guard.triggered and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.triggered and guard.signame == "SIGTERM"
+        tr.train_async(episodes=5, num_replicas=2, chunk=2,
+                       actor_threads=2, preempt=guard)
+    assert tr.preempted
+    doc = json.load(open(obs.blackbox_path))
+    assert doc["schema_version"] == BLACKBOX_SCHEMA_VERSION
+    assert doc["reason"] == "preempt:SIGTERM"
+    obs.close(status="preempted")
+    assert json.load(open(obs.blackbox_path))["reason"] == \
+        "preempt:SIGTERM"
+
+
+# --------------------------------------------------- ledger-off bit parity
+def test_flight_ledger_off_bit_parity():
+    """actor_threads=1 + frozen publishes: the same seed with the
+    recorder ON (window 64) vs OFF (window 0) produces bit-identical
+    replay rings, and the OFF stream carries zero flight events — the
+    recorder's byte-parity contract on the data path.  (Learned params
+    are the one timing-DEPENDENT output even at one actor — burst/
+    ingest interleaving decides what the ring holds when a burst
+    samples — so, exactly like the async determinism test, parity is
+    asserted on the ring, the deterministic producer side.)"""
+    import jax
+    from gsc_tpu.parallel.async_rl import AsyncConfig, run_async
+    from tests.test_async_rl import _setup
+
+    pddpg, state, make_buffers, scenario_fn = _setup(
+        episode_steps=4, rand_sigma=0.0, rand_mu=0.0)
+
+    def one_run(window):
+        hub = MetricsHub(series_window=window)
+        sink = ListSink()
+        hub.add_sink(sink)
+        res = run_async(pddpg, scenario_fn, state, make_buffers(),
+                        episodes=3, episode_steps=4, chunk=2, seed=0,
+                        cfg=AsyncConfig(actor_threads=1,
+                                        publish_bursts=10**6), hub=hub)
+        return res, sink.records
+
+    on, on_events = one_run(64)
+    off, off_events = one_run(0)
+    assert_equal = lambda a, b: np.testing.assert_array_equal(  # noqa: E731
+        np.asarray(a), np.asarray(b))
+    jax.tree_util.tree_map(assert_equal, on.buffers.data,
+                           off.buffers.data)
+    assert_equal(on.buffers.pos, off.buffers.pos)
+    assert_equal(on.buffers.size, off.buffers.size)
+    flight_kinds = {"async_actor_ep", "async_learner_spans"}
+    assert flight_kinds <= {e.get("event") for e in on_events}
+    assert not (flight_kinds & {e.get("event") for e in off_events})
+    assert on.info["episodes_drained"] == off.info["episodes_drained"] == 3
+    assert on.info["produced_steps"] == off.info["produced_steps"]

@@ -195,6 +195,14 @@ def worker(leg: str) -> int:
             "transitions_lost": info["transitions_lost"],
             "policy_lag_max": info["policy_lag_max"],
             "policy_lag_mean": info["policy_lag_mean"],
+            # flight-recorder lag/idle axes (PR 17): staleness
+            # percentiles + the dispatch-side idle twin of the
+            # learner-idle gate (max over actors; per-actor vector kept
+            # for the leg record)
+            "policy_lag_p50": info.get("policy_lag_p50", 0),
+            "policy_lag_p99": info.get("policy_lag_p99", 0),
+            "actor_idle_frac": info.get("actor_idle_frac", 0.0),
+            "actor_idle_fracs": info.get("actor_idle_fracs", []),
             "phases": timer.summary(),
             "jit_traces": traces(),
         })
@@ -272,6 +280,14 @@ def main(argv=None) -> int:
             "learner_idle_frac": idle,
             "policy_lag_max": max(a2["policy_lag_max"],
                                   a4["policy_lag_max"]),
+            # worst-case staleness p99 / actor-idle across the async
+            # legs: the bench_diff `policy_lag_p99` and
+            # `actor_idle_frac` bands gate these (BENCH_NOTES
+            # conventions for ASYNC rows)
+            "policy_lag_p99": max(a2.get("policy_lag_p99", 0),
+                                  a4.get("policy_lag_p99", 0)),
+            "actor_idle_frac": max(a2.get("actor_idle_frac", 0.0),
+                                   a4.get("actor_idle_frac", 0.0)),
             "produced_steps": a2["produced_steps"],
             "ingested_steps": a2["ingested_steps"],
             "sync_final_window_return": s["final_window_return"],

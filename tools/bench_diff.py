@@ -100,6 +100,16 @@ METRIC_RULES: List[Tuple] = [
     # in ratio units (the per-leg *_sps rates gate under the shared 15%
     # `sps` band above, and per-leg trace counts under `jit_traces`).
     ("learner_idle_frac", False, 0.25, 0.05),
+    # flight-recorder lag/idle axes on ASYNC rows: the p99 policy lag is
+    # the staleness contract (a learner suddenly training on much older
+    # acting policies regresses generalization claims even when raw sps
+    # holds), the max per-actor idle fraction is the dispatch-side twin
+    # of learner_idle_frac — an actor spending its wall blocked on the
+    # channel means the learn side became the bottleneck.  Both sit near
+    # small integers / zero on healthy runs, so both carry absolute
+    # floors (versions / ratio units).
+    ("policy_lag_p99", False, 0.50, 1.0),
+    ("actor_idle_frac", False, 0.25, 0.10),
 ]
 
 # filename patterns `ingest --scan` picks up.  perf.json ledgers and
@@ -147,6 +157,10 @@ def _bench_row(d: Dict) -> Dict:
                   # own lower-is-better band), speedups + curve metrics
                   "sync_sps", "async1_sps", "async2_sps", "async4_sps",
                   "learner_idle_frac", "async2_vs_sync", "async4_vs_sync",
+                  # flight-recorder lag/idle axes on ASYNC rows: p99
+                  # staleness + worst per-actor idle gate under their
+                  # own lower-is-better bands
+                  "policy_lag_p99", "actor_idle_frac",
                   "sync_final_window_return", "async_final_window_return",
                   "sync_auc_return", "async_auc_return"):
             if _num(d.get(k)) is not None:
@@ -678,6 +692,37 @@ def selftest() -> int:
         d = diff_rows(slower_scen, {**scrow, "name": "scen_base"})
         assert d["verdict"] == "regression" \
             and "factory_sps" in d["regressions"], d
+
+        # ASYNC flight-recorder axes: lag blow-up / actors starving on
+        # the channel regress under their own bands; the absolute
+        # floors absorb healthy-run jitter (lag oscillating by a
+        # version, idle a few points above zero)
+        arow = dump("ASYNC_r90.json", {
+            "metric": "env_steps_per_sec_per_chip", "status": "ok",
+            "sync_sps": 100.0, "async2_sps": 130.0,
+            "learner_idle_frac": 0.02, "policy_lag_p99": 2.0,
+            "actor_idle_frac": 0.05})
+        abase = extract_row(arow)
+        assert abase["metrics"]["policy_lag_p99"] == 2.0 \
+            and abase["metrics"]["actor_idle_frac"] == 0.05, \
+            abase["metrics"]
+        d = diff_rows({**abase, "name": "async_self"},
+                      {**abase, "name": "async_base"})
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        jittery = dict(abase, name="async_jitter",
+                       metrics={**abase["metrics"],
+                                "policy_lag_p99": 3.0,
+                                "actor_idle_frac": 0.11})
+        d = diff_rows(jittery, {**abase, "name": "async_base"})
+        assert d["verdict"] == "ok", d   # within floor-widened bands
+        stale = dict(abase, name="async_stale",
+                     metrics={**abase["metrics"],
+                              "policy_lag_p99": 9.0,
+                              "actor_idle_frac": 0.40})
+        d = diff_rows(stale, {**abase, "name": "async_base"})
+        assert d["verdict"] == "regression", d
+        for m in ("policy_lag_p99", "actor_idle_frac"):
+            assert m in d["regressions"], (m, d["regressions"])
 
         # a widened tolerance declassifies a small regression
         d = diff_rows({"name": "a", "metrics": {"x_mfu": 0.9}},

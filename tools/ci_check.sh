@@ -122,6 +122,18 @@ echo "== async smoke (decoupled actor/learner through the real CLI) =="
 # — tools/async_smoke.py asserts all of it
 env JAX_PLATFORMS=cpu python tools/async_smoke.py
 
+echo "== flight smoke (series rings + async trace + black-box post-mortem) =="
+# the same tiny --async run with the series recorder on must leave a
+# schema-versioned series.json whose last ring points equal the final
+# metrics.json gauges, an event stream that reconstructs a STRICT-
+# validator-clean trace (per-actor tracks, channel residency, balanced
+# publish->adopt flows), and a deliberately wedged fleet thread must
+# stall BY NAME then escalate into blackbox.json; the ASYNC row's new
+# policy_lag_p99/actor_idle_frac fields gate through bench_diff
+# (self-compare rc 0, injected staleness blow-up rc 1) —
+# tools/flight_smoke.py asserts all of it
+env JAX_PLATFORMS=cpu python tools/flight_smoke.py
+
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
 # a tiny CPU train run under an injected prefetcher death + NaN episode
 # must exit 0 with matching structured `recovery` events in events.jsonl

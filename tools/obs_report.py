@@ -37,6 +37,14 @@ does by default), prints:
   |TD-error| table (mixed batches AND the serial path's stamped
   topology), last-episode Q distribution moments, per-layer grad-norm
   peaks + param norms, replay fill;
+- an async-fleet section for ``cli train --async`` runs, from the
+  run-level ``async_train`` event plus the deferred flight-recorder
+  ledgers (``async_actor_ep`` / ``async_learner_spans``,
+  gsc_tpu.parallel.async_rl): a per-actor table (episodes / chunks /
+  steps / rollout wall / channel-blocked wall / idle fraction /
+  adoptions), the learner's policy-lag percentiles and wall
+  decomposition (ingest vs learn-burst vs idle), and the weight
+  adoption timeline (publish -> per-actor adopt latency per version);
 - a serving section for ``cli serve`` runs, from the ``serve_start`` /
   ``serve_stats`` events (gsc_tpu.serve.PolicyServer): tier, requests/s,
   p50/p99 latency overall and per batch bucket, bucket occupancy,
@@ -370,6 +378,9 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
     # learn_signal event per drained episode
     learning = _learning_summary(
         [e for e in events if e.get("event") == "learn_signal"])
+    # async-fleet section (cli train --async): the run-level async_train
+    # info event plus the deferred flight-recorder ledgers
+    async_fleet = _async_summary(events)
     # serving section (cli serve runs): the final serve_stats event holds
     # the cumulative numbers; serve_start carries startup + cache hits
     serve_start = next((e for e in events
@@ -465,6 +476,7 @@ def summarize(events: List[Dict], mem_growth_threshold: float = 0.2,
         "topo_mix": topo_mix,
         "per_topology": per_topology,
         "learning": learning,
+        "async_fleet": async_fleet,
         "rows": rows,
         "phase_summary": phase_summary,
         "stalls": stalls,
@@ -519,6 +531,112 @@ def _learning_summary(learn_events: List[Dict]) -> Optional[Dict]:
         "grad_norms_last": last.get("grad_norms") or {},
         "param_norms_last": last.get("param_norms") or {},
         "replay_fill_last": (last.get("replay") or {}).get("fill"),
+    }
+
+
+def _async_summary(events: List[Dict]) -> Optional[Dict]:
+    """Condense the async-fleet flight-recorder records: the run-level
+    ``async_train`` info event plus the deferred ``async_actor_ep`` /
+    ``async_learner_spans`` ledgers (gsc_tpu.parallel.async_rl).  Three
+    views: a per-actor table (episodes / chunks / steps / rollout wall /
+    channel-blocked wall / idle fraction / adoptions), the learner's
+    lag + wall decomposition (ingest vs learn-burst vs idle), and the
+    weight adoption timeline (publish -> per-actor adopt latency per
+    version)."""
+    info = next((e for e in reversed(events)
+                 if e.get("event") == "async_train"), None)
+    actor_eps = [e for e in events if e.get("event") == "async_actor_ep"]
+    spans = [e for e in events
+             if e.get("event") == "async_learner_spans"]
+    if info is None and not actor_eps and not spans:
+        return None
+    fracs = (info or {}).get("actor_idle_fracs") or []
+    per_actor: Dict[int, Dict] = {}
+    adopts_by_ver: Dict[int, Dict[int, float]] = {}
+    for ev in actor_eps:
+        aid = int(ev.get("actor", 0))
+        rec = per_actor.setdefault(aid, {
+            "episodes": 0, "chunks": 0, "steps": 0, "rollout_s": 0.0,
+            "blocked_s": 0.0, "adopts": 0, "last_version": 0})
+        rec["episodes"] += 1
+        for c in ev.get("chunks") or []:
+            rec["chunks"] += 1
+            rec["rollout_s"] += float(c[1]) - float(c[0])
+        for p in ev.get("puts") or []:
+            rec["blocked_s"] += float(p[1])
+            rec["steps"] += int(p[2])
+        for a in ev.get("adopts") or []:
+            rec["adopts"] += 1
+            ver = int(a[1])
+            rec["last_version"] = max(rec["last_version"], ver)
+            prev = adopts_by_ver.setdefault(ver, {}).get(aid)
+            ts = float(a[0])
+            if prev is None or ts < prev:
+                adopts_by_ver[ver][aid] = ts
+    for aid, rec in per_actor.items():
+        rec["rollout_s"] = round(rec["rollout_s"], 4)
+        rec["blocked_s"] = round(rec["blocked_s"], 4)
+        if aid < len(fracs):
+            rec["idle_frac"] = fracs[aid]
+    ingest_s = burst_s = 0.0
+    n_ingests = n_bursts = 0
+    lags: List[int] = []
+    publishes: Dict[int, float] = {}
+    for ev in spans:
+        for r in ev.get("ingests") or []:
+            n_ingests += 1
+            ingest_s += float(r[1]) - float(r[0])
+            lags.append(int(r[4]))
+        for r in ev.get("bursts") or []:
+            n_bursts += 1
+            burst_s += float(r[1]) - float(r[0])
+        for r in ev.get("publishes") or []:
+            ver, ts = int(r[1]), float(r[0])
+            if ver not in publishes or ts < publishes[ver]:
+                publishes[ver] = ts
+    timeline = []
+    for ver in sorted(publishes):
+        timeline.append({
+            "version": ver, "publish_ts": publishes[ver],
+            "adopt_lag_s": {
+                aid: round(ts - publishes[ver], 4)
+                for aid, ts in sorted(
+                    (adopts_by_ver.get(ver) or {}).items())}})
+    orphan_adopts = sorted(v for v in adopts_by_ver if v not in publishes)
+    wall = (info or {}).get("wall_s")
+    idle_s = (info or {}).get("learner_idle_s")
+    decomposition = {
+        "ingest_s": round(ingest_s, 4), "n_ingests": n_ingests,
+        "burst_s": round(burst_s, 4), "n_bursts": n_bursts,
+        "idle_s": idle_s,
+        # the remainder is scheduling + publish + drain overhead — a
+        # learner whose wall is neither ingesting, learning nor idling
+        # is losing time to the loop itself
+        "other_s": (round(wall - ingest_s - burst_s - idle_s, 4)
+                    if isinstance(wall, (int, float))
+                    and isinstance(idle_s, (int, float)) else None),
+    }
+    lag = {
+        "samples": len(lags),
+        "max": max(lags) if lags else 0,
+        "mean": (round(sum(lags) / len(lags), 4) if lags else 0.0),
+    }
+    if info:
+        for k in ("policy_lag_p50", "policy_lag_p99", "policy_lag_max",
+                  "policy_lag_mean"):
+            if isinstance(info.get(k), (int, float)):
+                lag[k.replace("policy_lag_", "")] = info[k]
+    return {
+        "info": {k: info.get(k) for k in (
+            "actors", "episodes_drained", "produced_steps",
+            "ingested_steps", "transitions_lost", "bursts", "publishes",
+            "published_version", "wall_s", "learner_idle_frac",
+            "actor_idle_frac")} if info else None,
+        "per_actor": per_actor,
+        "lag": lag,
+        "decomposition": decomposition,
+        "adoption_timeline": timeline,
+        "orphan_adopt_versions": orphan_adopts,
     }
 
 
@@ -701,6 +819,58 @@ def render_text(summary: Dict, out=sys.stdout):
                 w(f"    {layer:<28} peak {ln['grad_norm_peak'][layer]:>12} "
                   f" last {_fmt(ln['grad_norms_last'].get(layer), 12)} "
                   f" param {_fmt(ln['param_norms_last'].get(layer), 12)}\n")
+    af = summary.get("async_fleet")
+    if af:
+        inf = af.get("info") or {}
+        w(f"\nasync fleet ({inf.get('actors', '?')} actor(s), wall "
+          f"{inf.get('wall_s', '?')}s): produced "
+          f"{inf.get('produced_steps', '?')} steps, ingested "
+          f"{inf.get('ingested_steps', '?')}, lost "
+          f"{inf.get('transitions_lost', '?')}; "
+          f"{inf.get('bursts', '?')} burst(s), "
+          f"{inf.get('publishes', '?')} publish(es) "
+          f"(last v{inf.get('published_version', '?')})\n")
+        lag = af.get("lag") or {}
+        w(f"  policy lag (versions): mean {_fmt(lag.get('mean'), 1)}  "
+          f"p50 {_fmt(lag.get('p50'), 1)}  p99 {_fmt(lag.get('p99'), 1)}  "
+          f"max {_fmt(lag.get('max'), 1)}  "
+          f"({lag.get('samples', 0)} ingest(s))\n")
+        dec = af.get("decomposition") or {}
+        w(f"  learner wall: ingest {_fmt(dec.get('ingest_s'), 1)}s "
+          f"({dec.get('n_ingests')}x)  learn-burst "
+          f"{_fmt(dec.get('burst_s'), 1)}s ({dec.get('n_bursts')}x)  "
+          f"idle {_fmt(dec.get('idle_s'), 1)}s "
+          f"(frac {_fmt(inf.get('learner_idle_frac'), 1)})  "
+          f"other {_fmt(dec.get('other_s'), 1)}s\n")
+        if af.get("per_actor"):
+            w(f"  {'actor':>6} {'episodes':>8} {'chunks':>7} {'steps':>8} "
+              f"{'rollout_s':>10} {'blocked_s':>10} {'idle_frac':>10} "
+              f"{'adopts':>7} {'last_v':>7}\n")
+            for aid in sorted(af["per_actor"]):
+                rec = af["per_actor"][aid]
+                w(f"  {aid:>6} {_fmt(rec.get('episodes'), 8)} "
+                  f"{_fmt(rec.get('chunks'), 7)} "
+                  f"{_fmt(rec.get('steps'), 8)} "
+                  f"{_fmt(rec.get('rollout_s'), 10)} "
+                  f"{_fmt(rec.get('blocked_s'), 10)} "
+                  f"{_fmt(rec.get('idle_frac'), 10)} "
+                  f"{_fmt(rec.get('adopts'), 7)} "
+                  f"{_fmt(rec.get('last_version'), 7)}\n")
+        if af.get("adoption_timeline"):
+            w("  adoption timeline (publish wall offset; per-actor "
+              "adopt lag after the publish):\n")
+            t00 = af["adoption_timeline"][0].get("publish_ts") or 0.0
+            for rec in af["adoption_timeline"]:
+                dt = (rec.get("publish_ts") or 0.0) - t00
+                adopters = rec.get("adopt_lag_s") or {}
+                tail = "  ".join(
+                    f"actor{aid} +{adopters[aid]:.3f}s"
+                    for aid in sorted(adopters)) or "(not adopted)"
+                w(f"    +{dt:7.3f}s  v{rec.get('version')}  -> {tail}\n")
+        if af.get("orphan_adopt_versions"):
+            w("  (adopted version(s) with no recorded publish: "
+              + ", ".join(f"v{v}" for v in af["orphan_adopt_versions"])
+              + " — initial weights or a truncated ledger)\n")
     if perf and perf.get("entries"):
         w("\nperf (device-cost ledger, per watched entry point):\n")
         w(f"  {'entry':<20} {'flops':>12} {'bytes':>12} {'fusions':>8} "
@@ -992,6 +1162,38 @@ def _synthetic_events(path: str, episodes: int = 5):
               "final": True, "workers": ["w0", "w1"], "requests": 200,
               "swaps": 2, "brownout": {"slo_burn": 0, "overflow": 5},
               "per_worker": {}, "slo": None})
+        # async-fleet flight recorder (cli train --async): the deferred
+        # per-actor episode ledgers + learner spans + the run-level
+        # async_train info event — the report renders the per-actor
+        # table, the lag/idle decomposition and the adoption timeline
+        t = base + 4
+        emit({"event": "async_actor_ep", "ts": t + 1.0, "run": "selftest",
+              "ep": 0, "actor": 0,
+              "chunks": [[t, t + 0.1, 0], [t + 0.2, t + 0.3, 1]],
+              "puts": [[t + 0.1, 0.02, 64, 0, 1],
+                       [t + 0.3, 0.0, 64, 1, 3]],
+              "adopts": [[t + 0.15, 1]]})
+        emit({"event": "async_actor_ep", "ts": t + 1.0, "run": "selftest",
+              "ep": 1, "actor": 1,
+              "chunks": [[t + 0.05, 0.15 + t, 0]],
+              "puts": [[t + 0.15, 0.5, 64, 0, 2]],
+              "adopts": [[t + 0.4, 1]]})
+        emit({"event": "async_learner_spans", "ts": t + 1.0,
+              "run": "selftest", "part": 0, "parts": 1,
+              "ingests": [[t + 0.11, t + 0.12, 64, 0, 0, 1],
+                          [t + 0.16, t + 0.17, 64, 0, 0, 2],
+                          [t + 0.31, t + 0.32, 64, 1, 1, 3]],
+              "bursts": [[t + 0.12, t + 0.14, 2]],
+              "publishes": [[t + 0.14, 1]]})
+        emit({"event": "async_train", "ts": t + 1.1, "run": "selftest",
+              "actors": 2, "episodes_drained": 2, "produced_steps": 192,
+              "ingested_steps": 192, "transitions_lost": 0, "bursts": 1,
+              "publishes": 1, "published_version": 1, "max_staleness": 1,
+              "max_replay_lag": 64, "policy_lag_max": 1,
+              "policy_lag_mean": 0.33, "policy_lag_p50": 0,
+              "policy_lag_p99": 1, "wall_s": 1.0, "learner_idle_s": 0.2,
+              "learner_idle_frac": 0.2,
+              "actor_idle_fracs": [0.02, 0.5], "actor_idle_frac": 0.5})
         emit({"event": "run_end", "ts": base + episodes + 1,
               "run": "selftest", "status": "ok", "episodes": episodes})
 
@@ -1168,6 +1370,42 @@ def selftest() -> int:
         assert [s["version"] for s in sv["swap_timeline"]] == [2, 2] \
             and sv["swap_timeline"][0]["requests_in_flight"] == 3, \
             "hot-swap timeline lost"
+        # async-fleet section: per-actor table, lag/idle decomposition,
+        # adoption timeline — all three views reconstructed from the
+        # deferred flight-recorder ledgers + the async_train info event
+        af = summary["async_fleet"]
+        assert af and af["info"]["actors"] == 2 \
+            and af["info"]["transitions_lost"] == 0, af
+        assert set(af["per_actor"]) == {0, 1}, af["per_actor"]
+        a0 = af["per_actor"][0]
+        assert a0["episodes"] == 1 and a0["chunks"] == 2 \
+            and a0["steps"] == 128 and a0["adopts"] == 1 \
+            and a0["last_version"] == 1, a0
+        assert abs(a0["rollout_s"] - 0.2) < 1e-6 \
+            and abs(a0["blocked_s"] - 0.02) < 1e-6, a0
+        assert a0["idle_frac"] == 0.02 \
+            and af["per_actor"][1]["idle_frac"] == 0.5, af["per_actor"]
+        assert af["lag"]["samples"] == 3 and af["lag"]["max"] == 1 \
+            and af["lag"]["p99"] == 1, af["lag"]
+        dec = af["decomposition"]
+        assert dec["n_ingests"] == 3 and dec["n_bursts"] == 1 \
+            and abs(dec["ingest_s"] - 0.03) < 1e-6 \
+            and abs(dec["burst_s"] - 0.02) < 1e-6, dec
+        assert dec["idle_s"] == 0.2 \
+            and abs(dec["other_s"] - (1.0 - 0.03 - 0.02 - 0.2)) < 1e-6, \
+            dec
+        tl = af["adoption_timeline"]
+        assert len(tl) == 1 and tl[0]["version"] == 1, tl
+        # actor0 adopted 0.01s after the publish, actor1 0.26s after
+        assert abs(tl[0]["adopt_lag_s"][0] - 0.01) < 1e-6 \
+            and abs(tl[0]["adopt_lag_s"][1] - 0.26) < 1e-6, tl
+        assert af["orphan_adopt_versions"] == [], af
+        async_txt = io.StringIO()
+        render_text(summary, out=async_txt)
+        assert "async fleet (2 actor(s)" in async_txt.getvalue() \
+            and "adoption timeline" in async_txt.getvalue() \
+            and "learner wall: ingest" in async_txt.getvalue(), \
+            "async-fleet section not rendered"
         fleet_txt = io.StringIO()
         render_text(summary, out=fleet_txt)
         assert "fleet: 2 worker(s)" in fleet_txt.getvalue() \
