@@ -1340,11 +1340,19 @@ class Trainer:
         scenarios are keyed by GLOBAL episode index, so what an episode
         trains on does not depend on which actor thread ran it.
 
+        Mesh composition: ``plan`` (``--mesh``) now composes — the replay
+        ring lives dp-sharded on the learner mesh (``plan.ring_sharding``)
+        and ``run_async`` pre-builds the plan-bound dispatch plus the
+        AOT-compiled per-shard donated ingest BEFORE any actor thread
+        starts, under one run-wide compile-cache guard (the lazy-build
+        race that used to force a refusal is dead code).  Learn-bursts
+        run under the full pjit plan (tp rulebooks compose), and each
+        publish gathers params to host once for both the actor watchers
+        and the serving fleet.  Tp-only meshes (dp=1 with >1 devices)
+        are still refused — the ring has no dp axis to shard over.
+
         When sync still wins (documented limits, refused loudly):
 
-        - ``plan`` (``--mesh``) — the sharded dispatch's lazy jit build
-          and device-placement memos are not safe under concurrent actor
-          dispatch; the async path is single-mesh for now.
         - ``--fault-plan`` — no injection sites or rollback guard here,
           same refusal as train_parallel.
         - Bit-exact learning curves vs the sync control — actors act on
@@ -1365,11 +1373,9 @@ class Trainer:
                 "path (no injection sites or rollback guard); run the "
                 "chaos plan with --replicas 1")
         if plan is not None:
-            raise ValueError(
-                "--async does not compose with --mesh yet: the sharded "
-                "dispatch builds its jits lazily and memoizes device "
-                "placements, neither of which is safe under concurrent "
-                "actor dispatch — run sharded training synchronously")
+            # dp-sharded replay needs a dp axis; tp-only grids refuse
+            # with the recarve instructions (partition.py)
+            plan.assert_async_capable()
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
@@ -1381,7 +1387,8 @@ class Trainer:
                     start_episode=start_episode,
                     ckpt_manager=ckpt_manager,
                     ckpt_interval=ckpt_interval, preempt=preempt,
-                    publisher=publisher, publish_bursts=publish_bursts,
+                    plan=plan, publisher=publisher,
+                    publish_bursts=publish_bursts,
                     curriculum=curriculum, max_staleness=max_staleness,
                     learn_ratio=learn_ratio, throttle_s=throttle_s)
         from ..parallel import ParallelDDPG
@@ -1423,6 +1430,7 @@ class Trainer:
                              gnn_impl=self.ddpg.actor.gnn_impl,
                              per_replica_topology=(mix_plan is not None
                                                    or factory is not None),
+                             plan=plan,
                              learn_ledger=self.ddpg.learn_ledger)
         seg_names = (self.learn_obs.segment_names
                      if self.learn_obs is not None else None)
@@ -1551,6 +1559,12 @@ class Trainer:
                 # episode — the satellite gauge that stays correct when
                 # the ring lives sharded)
                 hub.gauge("replay_fill_frac", buffer_fill_frac(ring))
+                # this host's addressable share of the (possibly
+                # dp-sharded) ring — metadata only, no sync; equals the
+                # global gauge on a single host and the true per-host
+                # HBM spend on a pod
+                hub.gauge("replay_local_bytes",
+                          buffer_nbytes(ring, local=True))
             self._last_drained = max(self._last_drained, ep)
 
         def on_burst(n, st, metrics):
@@ -1567,9 +1581,12 @@ class Trainer:
 
         def checkpoint_fn(st, ring, n_drained):
             # same finite-verified host-layout save as train_parallel
-            # (no rollback guard on this path either)
-            if self._finite_host(st):
-                ckpt_manager.save(st, jax.device_get(ring),
+            # (no rollback guard on this path either); under a plan the
+            # state gathers through the plan's fns so the checkpoint
+            # layout stays mesh-shape-agnostic (elastic resume)
+            h_st = plan.gather_state(st) if plan is not None else st
+            if self._finite_host(h_st):
+                ckpt_manager.save(h_st, jax.device_get(ring),
                                   episode=self._last_drained + 1)
             else:
                 self._recover(

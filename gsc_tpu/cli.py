@@ -455,9 +455,14 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "adopt between dispatches.  Off-policy staleness is "
                    "bounded (--max-staleness) and measured (policy_lag / "
                    "replay_lag gauges, actor_idle/learner_idle phases).  "
-                   "Does not compose with --mesh or --fault-plan yet; "
-                   "learning curves match the sync control within "
-                   "bench_diff's curve bands, not bit-exactly")
+                   "Composes with --mesh over the dp axis: the replay "
+                   "ring lives dp-sharded on the learner mesh, ingest is "
+                   "an AOT-compiled per-shard donated write (asserted "
+                   "collective-free) and learn bursts run under the full "
+                   "pjit plan (tp-only meshes, dp=1, are refused).  Does "
+                   "not compose with --fault-plan yet; learning curves "
+                   "match the sync control within bench_diff's curve "
+                   "bands, not bit-exactly")
 @click.option("--async-actors", default=2, show_default=True,
               help="rollout threads for --async (each owns its own env "
                    "replicas batch, PRNG stream and adopted weights; "
@@ -548,12 +553,6 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             raise click.BadParameter(
                 "--async decouples the replica rollout from the learner "
                 "— it requires the replica-parallel path (--replicas > 1)")
-        if mesh:
-            raise click.BadParameter(
-                "--async does not compose with --mesh yet: the sharded "
-                "dispatch builds its jits lazily and memoizes device "
-                "placements, which the actor threads would race — drop "
-                "one of the two flags")
         if fault_plan:
             raise click.BadParameter(
                 "--async does not compose with --fault-plan yet: fault "
@@ -606,6 +605,13 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                 f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
                 f"device_count={dp_ * mp_}")
         plan = ShardingPlan.from_spec(mesh, rules=partition_rules)
+        if async_mode:
+            # dp-sharded replay needs a dp axis — refuse tp-only grids
+            # here with the flag's name, not from inside the run loop
+            try:
+                plan.assert_async_capable()
+            except ValueError as e:
+                raise click.BadParameter(str(e))
     elif partition_rules != "replicated":
         raise click.BadParameter(
             f"--partition-rules {partition_rules} has no effect without "
@@ -852,7 +858,7 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                         init_state=init_state, init_buffers=init_buffer,
                         start_episode=start_episode,
                         ckpt_manager=manager, ckpt_interval=ckpt_interval,
-                        preempt=guard, publisher=publisher,
+                        preempt=guard, plan=plan, publisher=publisher,
                         publish_bursts=publish_bursts,
                         curriculum=curriculum_cfg,
                         max_staleness=max_staleness,

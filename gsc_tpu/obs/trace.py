@@ -483,11 +483,18 @@ def build_trace(events: List[Dict]) -> Dict:
                          id=flow_id, bp="e")
         elif kind == "async_learner_spans":
             ltid = TRACE_TRACKS["learner"]
-            for i0, i1, steps, ver, lag, seq in (ev.get("ingests") or []):
+            for row in (ev.get("ingests") or []):
+                # rows grew a trailing dp-shard id (producer's stable
+                # assignment) with the sharded async ring; pre-shard
+                # recordings carry 6 elements — unpack tolerantly
+                i0, i1, steps, ver, lag, seq = row[:6]
+                args = {"seq": int(seq), "steps": int(steps),
+                        "version": int(ver), "policy_lag": int(lag)}
+                if len(row) > 6:
+                    args["replay_shard"] = int(row[6])
                 push("X", "replay_ingest", ltid, _us(float(i0), t0),
                      dur=round(max(float(i1) - float(i0), 0.0) * 1e6, 1),
-                     args={"seq": int(seq), "steps": int(steps),
-                           "version": int(ver), "policy_lag": int(lag)})
+                     args=args)
             for b0, b1, n in (ev.get("bursts") or []):
                 push("X", f"learn_burst {int(n)}", ltid,
                      _us(float(b0), t0),

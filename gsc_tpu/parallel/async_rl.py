@@ -42,6 +42,25 @@ with ``donate=False`` — actors hand their scratch blocks to the learner
 by reference, so a donating rollout would consume buffers another thread
 still reads.  The ONLY donated call is ``replay_ingest`` on the shared
 ring, which exactly one thread (the learner) owns and always rebinds.
+
+Mesh composition (``--async --mesh``): when the ParallelDDPG carries a
+:class:`~gsc_tpu.parallel.partition.ShardingPlan`, the replay ring lives
+dp-SHARDED on the learner mesh (``plan.ring_sharding`` — the same row
+layout the sharded rollout already emits blocks in), and ``run_async``
+kills the lazy-build race the old refusal guarded by pre-building
+EVERYTHING before the first actor thread exists: the plan-bound dispatch
+jits, then the sharded donated ingest — AOT-lowered so its partitioned
+HLO can be mined and asserted collective-free (row-aligned ring/block/
+cursor shardings make the scatter one independent per-shard donated
+write; a block lands on the mesh once, in its final shard, and never
+moves again).  The whole run executes under ONE
+``no_persistent_compile_cache`` guard (the multi-device-CPU cache wart,
+see partition.py), which also makes the per-dispatch inner guards
+inert — no actor thread ever toggles global jax config.  Learn-bursts
+dispatch through the same plan-bound binding the sync path uses (tp
+rulebooks compose unchanged), and publishes gather params to host ONCE
+so the actor watchers and the serving fleet's hot-swap read the same
+weight bytes.
 """
 from __future__ import annotations
 
@@ -57,13 +76,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..agents.buffer import ReplayBuffer
+from ..agents.buffer import ReplayBuffer, buffer_nbytes
+from .partition import (actor_shard_assignment, no_persistent_compile_cache,
+                        ring_shard_rows)
 
 log = logging.getLogger("gsc_tpu.parallel.async_rl")
 
 
 @lru_cache(maxsize=None)
-def make_replay_ingest(num_replicas: int, capacity: int):
+def make_replay_ingest(num_replicas: int, capacity: int, sharding=None):
     """The jitted replay service insert: fold one ``[B, T, ...]``
     transition block (an actor's scratch ring in insertion order) into
     the shared ``[B, cap, ...]`` ring at each replica's write cursor.
@@ -73,16 +94,24 @@ def make_replay_ingest(num_replicas: int, capacity: int):
     the ring exclusively and always rebind from the return (the learner
     loop does).  ``T`` is static (the actors' chunk size), so the whole
     async interleaving runs through ONE trace of this function.
-    Memoized by ``(B, cap)``: a warmup ``run_async`` followed by a
-    measured one (the bench split) reuses the SAME jit — the steady
-    window stays zero-retrace across calls."""
-    B = int(num_replicas)
-    rows = jnp.arange(B)[:, None]
+    Memoized by ``(B, cap, sharding)``: a warmup ``run_async`` followed
+    by a measured one (the bench split) reuses the SAME jit — the steady
+    window stays zero-retrace across calls.
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def replay_ingest(buffers: ReplayBuffer, block: Any) -> ReplayBuffer:
+    With ``sharding`` (a plan's ``ring_sharding``): ring, block AND the
+    per-replica cursors all carry the same row layout, and the fold runs
+    under ``shard_map`` — each device scatters its OWN contiguous row
+    block with LOCAL indices.  (Plain GSPMD cannot row-partition this
+    scatter: the global ``[B, T]`` index arrays make it all-gather the
+    ring — measured 28 all-gathers at 4 shards — while the shard_map
+    body is collective-free by construction.)  The caller (``run_async``
+    prewarm) AOT-lowers this jit and asserts the partitioned program
+    contains ZERO collective ops."""
+    B = int(num_replicas)
+
+    def _fold(buffers: ReplayBuffer, block: Any, rows) -> ReplayBuffer:
         T = jax.tree_util.tree_leaves(block)[0].shape[1]
-        # per-replica wrapped slot indices [B, T] from the write cursor
+        # per-replica wrapped slot indices [rows, T] from the write cursor
         idx = (buffers.pos[:, None] + jnp.arange(T)[None, :]) % capacity
         data = jax.tree_util.tree_map(
             lambda d, s: d.at[rows, idx].set(s.astype(d.dtype)),
@@ -90,6 +119,35 @@ def make_replay_ingest(num_replicas: int, capacity: int):
         return buffers.replace(
             data=data, pos=(buffers.pos + T) % capacity,
             size=jnp.minimum(buffers.size + T, capacity))
+
+    if sharding is None:
+        @partial(jax.jit, donate_argnums=(0,))
+        def replay_ingest(buffers: ReplayBuffer,
+                          block: Any) -> ReplayBuffer:
+            return _fold(buffers, block, jnp.arange(B)[:, None])
+
+        return replay_ingest
+
+    from jax.experimental.shard_map import shard_map
+    mesh, spec = sharding.mesh, sharding.spec
+
+    def _local_fold(buffers: ReplayBuffer, block: Any) -> ReplayBuffer:
+        # runs per-device on the shard's own rows: cursors/ring/block all
+        # arrive pre-sliced, so the row indices are a local iota
+        return _fold(buffers, block,
+                     jnp.arange(buffers.pos.shape[0])[:, None])
+
+    # check_rep off: every output is fully row-partitioned (nothing
+    # replicated to validate) and this jax version's replication checker
+    # rejects benign .at[].set patterns
+    sharded_fold = shard_map(_local_fold, mesh=mesh,
+                             in_specs=(spec, spec), out_specs=spec,
+                             check_rep=False)
+
+    @partial(jax.jit, donate_argnums=(0,),
+             in_shardings=(sharding, sharding), out_shardings=sharding)
+    def replay_ingest(buffers: ReplayBuffer, block: Any) -> ReplayBuffer:
+        return sharded_fold(buffers, block)
 
     return replay_ingest
 
@@ -148,10 +206,15 @@ class _Channel:
     def outstanding(self) -> int:
         return self.produced_steps - self.ingested_steps
 
-    def put(self, block, steps: int, version: int, timer=None,
+    def put(self, block, steps: int, version: int, shard: int = 0,
+            timer=None,
             on_wait: Optional[Callable[[float], None]] = None) -> int:
         """Enqueue one block; returns its seq (>=1, truthy), or 0 when
-        the run is stopping.  ``on_wait(seconds)`` receives each
+        the run is stopping.  ``shard`` is the producing actor's stable
+        dp-shard assignment (0 on an unsharded ring) — it rides the
+        queue so the learner's per-shard ingest heartbeats and the
+        flight recorder's ``replay_shard`` tags attribute each block
+        without a host sync.  ``on_wait(seconds)`` receives each
         backpressure slice (the per-actor idle the flight recorder
         attributes)."""
         with self._cond:
@@ -168,7 +231,7 @@ class _Channel:
                 return 0
             self._seq += 1
             self._blocks.append((block, int(steps), int(version),
-                                 self._seq))
+                                 self._seq, int(shard)))
             self.produced_steps += int(steps)
             self.max_observed_lag = max(self.max_observed_lag,
                                         self.outstanding())
@@ -227,10 +290,12 @@ class _FlightLedger:
 
     Row shapes (positional, kept terse because they land in JSONL):
 
-    - actor episode: ``{ep, actor, chunks: [[t0, t1, ver], ...],
+    - actor episode: ``{ep, actor, shard, chunks: [[t0, t1, ver], ...],
       puts: [[t_enq, wait_s, steps, ver, seq], ...],
       adopts: [[ts, ver], ...]}``
-    - ingest: ``[t0, t1, steps, ver, lag, seq]``
+    - ingest: ``[t0, t1, steps, ver, lag, seq, shard]`` (``shard`` is
+      the producing actor's dp-shard assignment — the ``replay_shard``
+      tag on the reconstructed learner spans; 0 on an unsharded ring)
     - burst: ``[t0, t1, n]`` / publish: ``[ts, ver]``
     """
 
@@ -245,10 +310,11 @@ class _FlightLedger:
         with self._lock:
             self.actor_eps.append(rec)
 
-    def note_ingest(self, t0, t1, steps, version, lag, seq):
+    def note_ingest(self, t0, t1, steps, version, lag, seq, shard=0):
         with self._lock:
             self.ingests.append([round(t0, 6), round(t1, 6), int(steps),
-                                 int(version), int(lag), int(seq)])
+                                 int(version), int(lag), int(seq),
+                                 int(shard)])
 
     def note_burst(self, t0, t1, n):
         with self._lock:
@@ -313,10 +379,56 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
     only thread that owns the carries, so a save can never race a
     rebind.
 
+    With a plan-carrying ``pddpg`` (``--async --mesh``) the whole run
+    executes under ONE ``no_persistent_compile_cache`` guard and a
+    prewarm builds every jit before the first actor thread starts: the
+    plan-bound dispatch, then the dp-sharded donated ingest (AOT-lowered
+    and asserted collective-free).  The ring is placed into
+    ``plan.ring_sharding`` residency here, so callers may hand a
+    single-device ring.  Tp-only meshes (no dp axis) are refused up
+    front via ``plan.assert_async_capable()``.
+
     Returns an :class:`AsyncResult`; ``info`` carries the drain-proved
     accounting: produced == ingested steps (no transition lost), the
-    learner idle fraction, burst count, publish count and the observed
-    policy/replay lag extrema."""
+    learner idle fraction, burst count, publish count, the observed
+    policy/replay lag extrema and — under a plan — ``ring_shards`` and
+    the AOT-mined ``ingest_collectives`` (always 0, by assertion)."""
+    plan = getattr(pddpg, "plan", None)
+    if plan is not None:
+        plan.assert_async_capable()
+        # ONE guard for the whole run (prewarm compiles, actor-thread
+        # dispatches, learner ingests/bursts): inside it the per-dispatch
+        # guards in dp.py read an unset cache dir and become inert, so no
+        # actor thread ever touches global jax config (the guard itself
+        # is not thread-safe — holding it once here is what makes the
+        # multi-device-CPU cache wart safe under threads)
+        with no_persistent_compile_cache(plan.mesh):
+            return _run_async_impl(
+                pddpg, scenario_fn, state, buffers, episodes,
+                episode_steps, chunk, seed, cfg, publisher=publisher,
+                hub=hub, timer=timer, on_episode=on_episode,
+                on_burst=on_burst, should_stop=should_stop,
+                start_episode=start_episode,
+                checkpoint_every=checkpoint_every,
+                checkpoint_fn=checkpoint_fn)
+    return _run_async_impl(
+        pddpg, scenario_fn, state, buffers, episodes, episode_steps,
+        chunk, seed, cfg, publisher=publisher, hub=hub, timer=timer,
+        on_episode=on_episode, on_burst=on_burst, should_stop=should_stop,
+        start_episode=start_episode, checkpoint_every=checkpoint_every,
+        checkpoint_fn=checkpoint_fn)
+
+
+def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
+                    episodes: int, episode_steps: int, chunk: int,
+                    seed: int, cfg: AsyncConfig, publisher=None, hub=None,
+                    timer=None, on_episode: Optional[Callable] = None,
+                    on_burst: Optional[Callable] = None,
+                    should_stop: Optional[Callable] = None,
+                    start_episode: int = 0, checkpoint_every: int = 0,
+                    checkpoint_fn: Optional[Callable] = None) -> AsyncResult:
+    """The loop body of :func:`run_async` (which owns the plan
+    validation and the run-wide compile-cache guard)."""
     from ..serve.fleet import VersionWatcher, WeightPublisher
 
     B = pddpg.B
@@ -352,7 +464,65 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
 
     if publisher is None:
         publisher = WeightPublisher(hub=hub)   # in-process channel only
-    replay_ingest = make_replay_ingest(B, cap)
+
+    plan = getattr(pddpg, "plan", None)
+    n_shards = plan.n_devices if plan is not None else 1
+    # stable actor->dp-shard assignment (observability contract: which
+    # shard's heartbeat each actor's blocks bump — see partition.py)
+    shard_of = actor_shard_assignment(n_actors, n_shards)
+    # the multi-device enqueue-order serializer (see
+    # ParallelDDPG.dispatch_lock): rollout/learn_burst dispatches
+    # already hold it inside their wrappers; the learner's ingest
+    # dispatch below shares it.  Single-device runs hold nothing.
+    dispatch_lock = getattr(pddpg, "dispatch_lock", None) \
+        if plan is not None else None
+    if dispatch_lock is None:
+        dispatch_lock = _noop()
+    ingest_collectives = None
+    if plan is not None:
+        # ---- prewarm: every jit exists BEFORE the first actor thread —
+        # the lazy-build race the old --mesh refusal guarded is dead
+        # code on this path.  (1) the plan-bound dispatch binding (one
+        # build populates rollout/chunk/learn jits);
+        pddpg.sharded_lowerable("rollout_episodes", state)
+        # (2) the ring's resident layout: rows carved over the dp grid
+        # exactly like the blocks the sharded rollout emits (a no-op
+        # when the caller already placed it);
+        buffers = jax.device_put(buffers, plan.ring_sharding)
+        ring_shard_rows(B, n_shards)   # validates B % shards == 0
+        # (3) the per-shard donated ingest, AOT-lowered so the
+        # PARTITIONED program's HLO proves the hot path moves nothing:
+        # zero gather/reshard/collective ops, just each shard's own
+        # row-aligned scatter.  The compiled executable IS the dispatch
+        # handle — block shapes are static, so the steady state cannot
+        # retrace by construction.
+        from ..analysis.hlo import collective_stats
+        ingest_jit = make_replay_ingest(B, cap,
+                                        sharding=plan.ring_sharding)
+
+        def _placed_zeros(leaf_shape_fn, tree):
+            return jax.tree_util.tree_map(
+                lambda l: jax.device_put(
+                    jnp.zeros(leaf_shape_fn(l), l.dtype),
+                    plan.ring_sharding), tree)
+
+        warm_ring = _placed_zeros(lambda l: l.shape, buffers)
+        warm_block = _placed_zeros(
+            lambda l: (l.shape[0], chunk) + l.shape[2:], buffers.data)
+        compiled = ingest_jit.lower(warm_ring, warm_block).compile()
+        stats = collective_stats(compiled.as_text())
+        ingest_collectives = int(stats["count"])
+        if ingest_collectives:
+            raise RuntimeError(
+                f"dp-sharded replay_ingest compiled with "
+                f"{ingest_collectives} collective op(s) "
+                f"({sorted(stats['ops'])}) — the ingest hot path must "
+                f"be a pure per-shard write; the ring/block shardings "
+                f"have diverged from plan.ring_sharding")
+        replay_ingest = compiled
+        del warm_ring, warm_block   # donation fodder, never dispatched
+    else:
+        replay_ingest = make_replay_ingest(B, cap)
     treedef = jax.tree_util.tree_structure(state.actor_params)
     base = jax.random.PRNGKey(seed)
 
@@ -447,6 +617,7 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                         wait0 = actor_wait_s[aid]
                         seq = channel.put(scratch.data, B * chunk,
                                           policy.policy_version,
+                                          shard=shard_of[aid],
                                           timer=timer, on_wait=on_wait)
                         if not seq:
                             return
@@ -463,8 +634,8 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                         first = False
                 if ledger is not None:
                     ledger.note_actor_episode({
-                        "ep": ep, "actor": aid, "chunks": chunks,
-                        "puts": puts, "adopts": adopts})
+                        "ep": ep, "actor": aid, "shard": shard_of[aid],
+                        "chunks": chunks, "puts": puts, "adopts": adopts})
                 with results_lock:
                     results.append({"episode": ep, "actor": aid,
                                     "policy_version":
@@ -511,8 +682,21 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
         if not force and (cfg.publish_bursts <= 0
                           or bursts % cfg.publish_bursts != 0):
             return
-        params = state.actor_params
-        if _finite_host(params):
+        if plan is not None:
+            # ONE gather per publish: pull the (possibly resident-
+            # sharded) actor params to host numpy here, once.  The
+            # publisher's npz flatten is then a zero-copy pass-through
+            # and every in-process subscriber (actor watchers) receives
+            # the same host leaves the serving fleet's hot-swap reads
+            # from disk — one publisher, two consumers, one gather.
+            params = jax.tree_util.tree_map(
+                lambda l: np.asarray(jax.device_get(l)),
+                state.actor_params)
+            finite = _finite_host(params)
+        else:
+            params = state.actor_params
+            finite = _finite_host(params)
+        if finite:
             publisher.publish(params, meta={"burst": bursts,
                                             "episodes": len(drained)})
             publishes += 1
@@ -581,19 +765,32 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
             while item is not None:
                 items.append(item)
                 item = channel.get_nowait()
-            for block, steps, version, seq in items:
+            for block, steps, version, seq, shard in items:
                 if hub is not None:
                     hub.note_thread_phase("learner", "ingest")
                 t_ing = time.time()
                 with (timer.phase("replay_ingest") if timer
                       else _noop()):
-                    buffers = replay_ingest(buffers, block)
+                    # a multi-device ingest dispatch must not interleave
+                    # its per-device enqueues with an actor's rollout
+                    # dispatch (the XLA:CPU rendezvous deadlock — see
+                    # ParallelDDPG.dispatch_lock); single-device runs
+                    # hold no lock
+                    with dispatch_lock:
+                        buffers = replay_ingest(buffers, block)
                 lag = publisher.version - version
                 policy_lags.append(lag)
                 outstanding = channel.outstanding()
                 if ledger is not None:
                     ledger.note_ingest(t_ing, time.time(), steps, version,
-                                  lag, seq)
+                                  lag, seq, shard)
+                if hub is not None and n_shards > 1:
+                    # per-shard ingest heartbeat: a cold shard names a
+                    # wedged actor (the stable assignment), without any
+                    # device sync — counter + beat are host-side
+                    hub.counter("replay_shard_ingest_total", shard=shard)
+                    hub.gauge("replay_shard_ingest_seq", seq, shard=shard)
+                    hub.beat(f"replay_shard{shard}")
                 if hub is not None:
                     # gauges keep the PR 16 last-value semantics; the
                     # histograms add mid-run p50/p99/max to /metrics and
@@ -685,6 +882,11 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
         "learner_idle_frac": round(idle_s / wall, 4) if wall > 0 else 0.0,
         "actor_idle_fracs": actor_fracs,
         "actor_idle_frac": max(actor_fracs) if actor_fracs else 0.0,
+        "ring_shards": n_shards,
+        "mesh": plan.describe() if plan is not None else None,
+        # AOT-mined collective count on the ingest hot path; the prewarm
+        # RAISES if it is ever nonzero, so a plan run always reports 0
+        "ingest_collectives": ingest_collectives,
     }
     if hub is not None:
         # live probes made way for final plain gauges (a post-run scrape
@@ -697,6 +899,15 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
             hub.gauge("actor_idle_frac", frac, actor=a)
             hub.series("actor_idle_frac", frac, actor=a)
         hub.gauge("actor_policy_version", publisher.version)
+        # ring residency accounting: global bytes vs THIS host's
+        # addressable-shard bytes (buffer_nbytes(local=True)) — under a
+        # dp-sharded ring on a multi-host pod the local gauge is the
+        # true per-host HBM spend; on one host they coincide.  Metadata
+        # reads only, no device sync.
+        hub.gauge("replay_ring_bytes", buffer_nbytes(buffers))
+        hub.gauge("replay_ring_local_bytes",
+                  buffer_nbytes(buffers, local=True))
+        hub.gauge("replay_ring_shards", n_shards)
         if ledger is not None:
             ledger.flush_deferred(hub)
     return AsyncResult(state=state, buffers=buffers,

@@ -127,6 +127,30 @@ class ParallelDDPG:
         tp = plan.resident_sharded
         self.entry_state_moves = 0
         fns = {}
+        # the async path dispatches rollouts from MANY actor threads:
+        # the build-once dict fill, the placement memo and the
+        # entry-move counter are the binding's only shared mutable
+        # state, so one lock makes every wrapper thread-safe (run_async
+        # additionally pre-builds via sharded_lowerable before any
+        # actor thread exists, so the lock is uncontended steady-state)
+        import threading as _threading
+        bind_lock = _threading.Lock()
+        # XLA:CPU multi-device executions rendezvous their partitions at
+        # every collective.  Enqueue order onto the per-device work
+        # queues follows the Python call, and the GIL is released inside
+        # it — so two threads dispatching multi-device programs
+        # concurrently can interleave their per-device enqueue loops
+        # inconsistently (device 0 sees program B first, devices 1..3
+        # see program A first) and BOTH programs deadlock at their first
+        # rendezvous, each holding the devices the other needs.
+        # Observed live on the forced-device async x mesh path: two
+        # actor threads' rollout dispatches stuck with complementary
+        # arrival sets.  Serializing the dispatch CALL (not the
+        # execution — dispatch is async; the call returns after
+        # enqueue) makes the per-device queue order globally consistent,
+        # which is deadlock-free by construction.  run_async shares
+        # this lock for its AOT-compiled ingest dispatch.
+        self.dispatch_lock = _threading.Lock()
 
         def build(state):
             # Two residency designs share this binding:
@@ -202,7 +226,8 @@ class ParallelDDPG:
                     getattr(l, "sharding", None) == s
                     for l, s in zip(leaves, ss_leaves)):
                 return state
-            self.entry_state_moves += 1
+            with bind_lock:
+                self.entry_state_moves += 1
             return jax.device_put(state, fns["_state_shardings"])
 
         def state_out(state):
@@ -231,16 +256,18 @@ class ParallelDDPG:
 
         def put_once(tree, sh):
             key = id(tree)
-            hit = memo.get(key)
-            if hit is not None and hit[0] is tree and hit[1] is sh:
-                return hit[2]
+            with bind_lock:
+                hit = memo.get(key)
+                if hit is not None and hit[0] is tree and hit[1] is sh:
+                    return hit[2]
             out = jax.device_put(tree, sh)
             # the retained `tree` ref keeps the id from being recycled;
             # the bound keeps a long run from accumulating every
             # episode's host traffic
-            memo[key] = (tree, sh, out)
-            while len(memo) > 8:
-                memo.popitem(last=False)
+            with bind_lock:
+                memo[key] = (tree, sh, out)
+                while len(memo) > 8:
+                    memo.popitem(last=False)
             return out
 
         def put_data(tree):
@@ -258,10 +285,22 @@ class ParallelDDPG:
         # two config reads and nothing else
         from .partition import no_persistent_compile_cache
 
+        def built(name, state):
+            # double-checked build: the lazy first-dispatch fill must not
+            # race a second thread into a duplicate trace
+            fn = fns.get(name)
+            if fn is not None:
+                return fn
+            with bind_lock:
+                if name not in fns:
+                    build(state)
+                return fns[name]
+
         def chunk_step(state, buffers, env_states, obs, topo, traffic,
                        episode_start_step, num_steps=None, learn=False):
-            fn = fns.get("chunk_step") or build(state)["chunk_step"]
-            with no_persistent_compile_cache(plan.mesh):
+            fn = built("chunk_step", state)
+            with no_persistent_compile_cache(plan.mesh), \
+                    self.dispatch_lock:
                 out = fn(state_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
                          put_once(topo, topo_sh), put_once(traffic, data),
@@ -271,9 +310,9 @@ class ParallelDDPG:
 
         def rollout_episodes(state, buffers, env_states, obs, topo,
                              traffic, episode_start_step, num_steps=None):
-            fn = (fns.get("rollout_episodes")
-                  or build(state)["rollout_episodes"])
-            with no_persistent_compile_cache(plan.mesh):
+            fn = built("rollout_episodes", state)
+            with no_persistent_compile_cache(plan.mesh), \
+                    self.dispatch_lock:
                 out = fn(state_in(state), put_data(buffers),
                          put_data(env_states), put_data(obs),
                          put_once(topo, topo_sh), put_once(traffic, data),
@@ -282,8 +321,9 @@ class ParallelDDPG:
             return (state_out(out[0]),) + out[1:]
 
         def learn_burst(state, buffers):
-            fn = fns.get("learn_burst") or build(state)["learn_burst"]
-            with no_persistent_compile_cache(plan.mesh):
+            fn = built("learn_burst", state)
+            with no_persistent_compile_cache(plan.mesh), \
+                    self.dispatch_lock:
                 out = fn(state_in(state), put_data(buffers))
             return (state_out(out[0]),) + out[1:]
 

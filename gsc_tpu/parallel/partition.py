@@ -438,3 +438,59 @@ class ShardingPlan:
         sharded BETWEEN dispatches, replicated inside the program — so
         their bit-equality contract is untouched."""
         return self.rules_name == "tp"
+
+    # --------------------------------------------------- async replay ring
+    @property
+    def ring_sharding(self) -> NamedSharding:
+        """Sharding of the device-resident ``[B, cap]`` async replay ring
+        — identical to ``data_sharding`` (replica axis 0 over the whole
+        grid) ON PURPOSE: the sharded rollout already emits transition
+        blocks in this layout, so an ingest whose ring, block, pos and
+        size all share it is a row-aligned scatter GSPMD partitions
+        per-shard with ZERO collectives.  Blocks land on the learner
+        mesh once, in their final shard, and never move again."""
+        return self.data_sharding
+
+    def assert_async_capable(self):
+        """Refuse meshes the decoupled actor/learner cannot shard replay
+        over: a tp-only grid (``dp == 1`` with more than one device) has
+        no data-parallel axis to carve the ``[B, cap]`` ring along, so
+        every ingest would reshard tensor-parallel state instead of
+        writing its own rows.  Raises with the recarve instructions."""
+        if self.dp == 1 and self.n_devices > 1:
+            raise ValueError(
+                f"--async composes with --mesh over the dp axis only: "
+                f"mesh {self.describe()} is tensor-parallel-only (dp=1), "
+                f"so the replay ring has no dp axis to shard over. "
+                f"Recarve the same {self.n_devices} devices as "
+                f"{self.n_devices}x1 (pure dp) or {max(2, self.dp)}x"
+                f"{self.n_devices // max(2, self.dp)}, or drop --async "
+                f"to run tensor-parallel synchronously.")
+
+
+def ring_shard_rows(num_replicas: int,
+                    n_shards: int) -> Tuple[Tuple[int, int], ...]:
+    """The STATIC row->shard map of the dp-sharded replay ring: GSPMD
+    carves axis 0 of a ``P(TRAIN_AXES)``-sharded ``[B, ...]`` leaf into
+    contiguous row blocks, so shard ``s`` owns rows ``[s*B/n, (s+1)*B/n)``
+    — returned as one ``(lo, hi)`` per shard.  This is the contract the
+    per-shard ingest heartbeats, the ``replay_shard`` flight-recorder
+    tags and the parity tests all read from; it never changes for the
+    life of a mesh shape."""
+    B, n = int(num_replicas), int(n_shards)
+    if n <= 0 or B % n != 0:
+        raise ValueError(
+            f"num_replicas ({B}) must divide evenly over {n} ring shards")
+    per = B // n
+    return tuple((s * per, (s + 1) * per) for s in range(n))
+
+
+def actor_shard_assignment(n_actors: int, n_shards: int) -> Tuple[int, ...]:
+    """Stable actor->dp-shard assignment: actor ``a`` reports against
+    shard ``a % n_shards``, forever.  Every actor's block spans all
+    shards (rollout keeps the full replica batch row-aligned), so the
+    assignment is an OBSERVABILITY contract, not a routing table: it
+    names which shard's ingest heartbeat an actor's blocks bump and
+    which ``replay_shard`` tag its flight-recorder spans carry, so a
+    cold shard points at a specific wedged actor."""
+    return tuple(a % max(1, int(n_shards)) for a in range(int(n_actors)))

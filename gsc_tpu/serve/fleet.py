@@ -111,7 +111,16 @@ class WeightPublisher:
       in-process — the async actor/learner bus — where the record
       carries ``fingerprint=None`` (hashing would force a device->host
       sync per publish for a consumer that never validates bytes; the
-      in-process handoff cannot tear)."""
+      in-process handoff cannot tear).
+
+    One-gather contract (``--async --mesh``): a learner on a sharded
+    mesh gathers params to host numpy ONCE per publish (run_async's
+    ``maybe_publish``) and hands the host tree here — ``_flatten``'s
+    ``np.asarray`` is then a zero-copy view, so the npz the serving
+    fleet's watchers read from disk and the leaves the in-process actor
+    subscribers adopt are the SAME host bytes.  ``_flatten`` still
+    accepts device/sharded leaves from other callers (``device_get``
+    assembles them), so sync-path publishes are unchanged."""
 
     def __init__(self, root: Optional[str] = None, keep_versions: int = 8,
                  hub=None, artifact_cache=None, artifact_keep: int = 8,
@@ -232,7 +241,11 @@ class WeightPublisher:
             return list(params)
         import jax
         leaves = jax.tree_util.tree_flatten(params)[0]
-        return [np.asarray(l) for l in leaves]
+        # device_get assembles sharded leaves (a multi-device mesh leaf
+        # cannot np.asarray directly on every jax version); host numpy
+        # passes through untouched, so a pre-gathered tree stays
+        # zero-copy
+        return [np.asarray(jax.device_get(l)) for l in leaves]
 
     def _prune_versions(self):
         """Keep the newest ``keep_versions`` (the latest is never

@@ -22,6 +22,19 @@ must
 - gate through ``bench_diff``: an ASYNC-shaped row self-compares clean
   (rc 0) while an injected env-steps/s regression is caught (rc 1).
 
+A second FORCED-4-DEVICE stage (fresh subprocess,
+``--xla_force_host_platform_device_count=4`` — the parent's jax is
+already initialised single-device) proves the ``--async --mesh``
+composition end to end: ``cli train --async --mesh 4x1`` must exit 0
+with the replay ring dp-sharded over all 4 devices
+(``async_train.ring_shards == 4``) and ZERO collectives on the
+compiled ingest (``ingest_collectives == 0`` — HLO-mined at prewarm),
+the same one-trace-per-entry-point contract as the single-device
+stage, a publisher version adopted by BOTH consumers — an actor
+(an episode acted under ``policy_version >= 1``) and a serve-side
+``VersionWatcher`` polling the ``--hot-swap-dir`` root — and a tp-only
+mesh (``--mesh 1x4``) refused with recarve instructions.
+
 Run by ``tools/ci_check.sh`` after the scenario stage; standalone:
 
     JAX_PLATFORMS=cpu python tools/async_smoke.py
@@ -42,6 +55,13 @@ ACTORS = 2
 # compile-dominated tiny run: this only proves the ledger exists and is
 # sane, not the steady-state decoupling claim (async_bench owns that)
 SMOKE_IDLE_MAX = 0.95
+# the mesh stage: enough episodes that a published version is adopted
+# by a later-acting episode DETERMINISTICALLY under the default
+# max_staleness=0 backpressure bound (two episodes per actor ahead max:
+# by episode index >= 4 at least one burst has published)
+MESH_DEVICES = 4
+MESH_EPISODES = 6
+MESH_TIMEOUT_S = 900
 
 
 def _configure_jax():
@@ -62,6 +82,137 @@ def _configure_jax():
 def fail(msg: str) -> int:
     print(f"async smoke: FAIL — {msg}")
     return 1
+
+
+def mesh_worker() -> int:
+    """The forced-4-device stage body (own subprocess: the parent's jax
+    is already initialised with one device)."""
+    _configure_jax()
+    import jax
+
+    if len(jax.devices()) != MESH_DEVICES:
+        return fail(f"mesh stage needs {MESH_DEVICES} forced host "
+                    f"devices, found {len(jax.devices())}")
+    from click.testing import CliRunner
+
+    from gsc_tpu.cli import cli
+    from tools.chaos_smoke import write_tiny_configs
+
+    tmp = tempfile.mkdtemp(prefix="gsc_async_mesh_")
+    args = write_tiny_configs(os.path.join(tmp, "cfg"))
+    hot = os.path.join(tmp, "hot")
+
+    # a tp-only carving of the same 4 devices is refused up front, with
+    # recarve instructions, before any compile
+    r = CliRunner().invoke(cli, [
+        "train", *args, "--episodes", "1", "--replicas", "4",
+        "--async", "--mesh", "1x4",
+        "--result-dir", os.path.join(tmp, "refused")])
+    if r.exit_code == 0 or "dp" not in r.output:
+        return fail(f"tp-only --async --mesh 1x4 not refused "
+                    f"(rc={r.exit_code}): {r.output[-500:]}")
+
+    r = CliRunner().invoke(cli, [
+        "train", *args, "--episodes", str(MESH_EPISODES),
+        "--replicas", str(MESH_DEVICES), "--chunk", "3",
+        "--async", "--async-actors", str(ACTORS),
+        "--mesh", f"{MESH_DEVICES}x1",
+        "--hot-swap-dir", hot, "--publish-interval", "1",
+        "--no-perf",
+        "--result-dir", os.path.join(tmp, "res")])
+    if r.exit_code != 0:
+        print(r.output)
+        if r.exception is not None:
+            import traceback
+            traceback.print_exception(type(r.exception), r.exception,
+                                      r.exception.__traceback__)
+        return fail(f"train rc={r.exit_code} under --async --mesh")
+    rdir = json.loads(r.output.strip().splitlines()[-1])["result_dir"]
+    events = [json.loads(line)
+              for line in open(os.path.join(rdir, "events.jsonl"))]
+
+    # the composed-path accounting tail: ring sharded over every device,
+    # zero collectives on the compiled ingest, nothing lost
+    at = [e for e in events if e["event"] == "async_train"]
+    if not at:
+        return fail("no async_train accounting event in the stream")
+    info = at[-1]
+    if info.get("ring_shards") != MESH_DEVICES:
+        return fail(f"ring_shards {info.get('ring_shards')} != "
+                    f"{MESH_DEVICES} — the replay ring did not shard "
+                    "over the mesh")
+    if info.get("ingest_collectives") != 0:
+        return fail(f"ingest_collectives {info.get('ingest_collectives')}"
+                    " — the dp-sharded ingest is paying a gather/reshard")
+    if info.get("mesh") != f"{MESH_DEVICES}x1":
+        return fail(f"async_train mesh {info.get('mesh')!r}")
+    if info["produced_steps"] != info["ingested_steps"] \
+            or info["transitions_lost"] != 0:
+        return fail(f"drain accounting broken under mesh: {info}")
+    if info.get("publishes", 0) < 1:
+        return fail(f"no publishes under mesh: {info}")
+
+    # zero retrace after warmup, same contract as the single-device
+    # stage: the sharded dispatch is PRE-built before actor threads
+    # start, the ingest is AOT-compiled at prewarm (its one .lower()
+    # counts as the single trace)
+    traces = {}
+    for e in events:
+        if e["event"] == "compile" and e.get("stage") == "trace":
+            traces[e["fn"]] = e.get("count")
+    for fn in ("rollout_episodes", "reset_all", "learn_burst"):
+        if traces.get(fn) != 1:
+            return fail(f"expected exactly 1 {fn} trace under --mesh, "
+                        f"saw {traces.get(fn)} (all: {traces})")
+    if (traces.get("replay_ingest") or 0) > 1:
+        return fail(f"replay_ingest traced {traces.get('replay_ingest')} "
+                    f"times (want <= 1): {traces}")
+
+    # publisher adoption, consumer 1 — an actor: with publish-interval 1
+    # and the default staleness bound, a later episode must have ACTED
+    # under a published version
+    eps = [e for e in events if e["event"] == "episode"]
+    if sorted(e["episode"] for e in eps) != list(range(MESH_EPISODES)):
+        return fail(f"episode events cover "
+                    f"{sorted(e['episode'] for e in eps)}")
+    top_ver = max(e.get("policy_version", 0) for e in eps)
+    if top_ver < 1:
+        return fail("no actor adopted a published version "
+                    f"(max episode policy_version {top_ver})")
+
+    # publisher adoption, consumer 2 — a serve watcher polling the SAME
+    # hot-swap root the learner published to (the one-publisher
+    # contract: learner actors and the serving fleet read the same
+    # bytes)
+    from gsc_tpu.serve.fleet import VersionWatcher, read_latest
+
+    rec = read_latest(hot)
+    if rec is None or rec.get("version", 0) < 1:
+        return fail(f"hot-swap root has no published version: {rec}")
+
+    class _Server:
+        policy_version = 0
+        fingerprint = None
+
+        def apply_weights(self, leaves, version, fingerprint, meta=None):
+            self.policy_version = version
+            self.fingerprint = fingerprint
+
+    srv = _Server()
+    watcher = VersionWatcher(hot, srv, publisher=None)
+    if not watcher.poll_once():
+        return fail("serve watcher did not swap to the published version")
+    if srv.policy_version != rec["version"]:
+        return fail(f"watcher adopted {srv.policy_version}, latest.json "
+                    f"says {rec['version']}")
+
+    print("async mesh smoke: OK — "
+          f"{MESH_EPISODES} episodes over {ACTORS} actors on a "
+          f"{MESH_DEVICES}x1 mesh, ring_shards={info['ring_shards']}, "
+          f"ingest_collectives={info['ingest_collectives']}, "
+          f"1 trace per entry point ({traces}), actor adopted v{top_ver}, "
+          f"serve watcher adopted v{srv.policy_version}, tp-only refused")
+    return 0
 
 
 def main() -> int:
@@ -187,10 +338,32 @@ def main() -> int:
           f"produced==ingested=={info['ingested_steps']}, "
           f"learner_idle_frac={info['learner_idle_frac']}, "
           "ASYNC row gated both directions")
+
+    # stage 2: the --async --mesh composition on 4 forced host devices
+    # (fresh subprocess — THIS process's jax initialised single-device)
+    import subprocess
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS":
+           f"--xla_force_host_platform_device_count={MESH_DEVICES}"}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker-mesh"],
+            capture_output=True, text=True, timeout=MESH_TIMEOUT_S,
+            env=env)
+    except subprocess.TimeoutExpired:
+        return fail(f"mesh stage timed out after {MESH_TIMEOUT_S}s")
+    tail = (out.stdout + out.stderr).strip().splitlines()
+    for line in tail[-25:]:
+        print(f"  [mesh] {line}")
+    if out.returncode != 0:
+        return fail(f"mesh stage rc={out.returncode}")
     return 0
 
 
 if __name__ == "__main__":
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__))))
+    if "--worker-mesh" in sys.argv:
+        sys.exit(mesh_worker())
     sys.exit(main())

@@ -67,6 +67,13 @@ METRIC_RULES: List[Tuple] = [
     ("p50_ms", False, 0.25),
     ("mfu", True, 0.15),
     ("sps", True, 0.15),             # mixtopo mixed/homogeneous rates
+    # ASYNC mesh rounds (r02+): scaling-efficiency axis — per-grid rate
+    # divided by device count (suffix does NOT end in `sps`, so it needs
+    # its own band), and the HLO-mined collective count on the compiled
+    # dp-sharded replay ingest (the zero-collective contract: ANY growth
+    # means blocks started paying a gather/reshard per ingest)
+    ("sps_per_device", True, 0.15),
+    ("ingest_collectives", False, 0.0),
     ("fusions", False, 0.05),
     ("jit_traces", False, 0.0),      # any retrace growth is churn
     ("legs_ok", True, 0.0),
@@ -157,6 +164,14 @@ def _bench_row(d: Dict) -> Dict:
                   # own lower-is-better band), speedups + curve metrics
                   "sync_sps", "async1_sps", "async2_sps", "async4_sps",
                   "learner_idle_frac", "async2_vs_sync", "async4_vs_sync",
+                  # ASYNC mesh rounds (r02): dp-leg rates (`_sps` band),
+                  # the per-device scaling axis (`_sps_per_device`
+                  # band), the zero-collective ingest count (0%
+                  # tolerance), speedup ratios as context
+                  "async_dp2_sps", "async_dp4_sps",
+                  "async2_sps_per_device", "async_dp2_sps_per_device",
+                  "async_dp4_sps_per_device", "ingest_collectives",
+                  "async_dp2_vs_async2", "async_dp4_vs_async2",
                   # flight-recorder lag/idle axes on ASYNC rows: p99
                   # staleness + worst per-actor idle gate under their
                   # own lower-is-better bands
@@ -171,7 +186,8 @@ def _bench_row(d: Dict) -> Dict:
         # MIXTOPO/SCEN rounds record per-leg trace counts; keys end in
         # `_jit_traces` so the 0%-tolerance retrace band gates them too
         for leg in ("homogeneous", "mixed", "factory", "host_regen",
-                    "sync", "async1", "async2", "async4"):
+                    "sync", "async1", "async2", "async4",
+                    "async_dp2", "async_dp4"):
             for fn, n in (d.get(f"jit_traces_{leg}") or {}).items():
                 if _num(n) is not None:
                     metrics[f"{leg}_{fn}_jit_traces"] = float(n)
@@ -184,7 +200,7 @@ def _bench_row(d: Dict) -> Dict:
                         ("pipeline", "precision", "substep_impl", "unroll",
                          "mesh", "topo_mix", "async_actors",
                          "policy_lag_max", "produced_steps",
-                         "ingested_steps") if k in d}}
+                         "ingested_steps", "ring_shards") if k in d}}
 
 
 def _multichip_row(d: Dict) -> Dict:
@@ -722,6 +738,36 @@ def selftest() -> int:
         d = diff_rows(stale, {**abase, "name": "async_base"})
         assert d["verdict"] == "regression", d
         for m in ("policy_lag_p99", "actor_idle_frac"):
+            assert m in d["regressions"], (m, d["regressions"])
+
+        # ASYNC mesh rounds (r02): the per-device scaling axis gates
+        # under its own 15% band, the zero-collective ingest contract
+        # under 0% tolerance — ONE collective appearing on the compiled
+        # dp ingest is a regression, not jitter; dp-leg trace counts
+        # ride the `_jit_traces` retrace band
+        mrow = dump("ASYNC_r91.json", {
+            "metric": "env_steps_per_sec_per_chip", "status": "ok",
+            "async2_sps": 130.0, "async_dp2_sps": 120.0,
+            "async_dp2_sps_per_device": 60.0,
+            "ingest_collectives": 0, "ring_shards": {"async_dp2": 2},
+            "jit_traces_async_dp2": {"replay_ingest": 1}})
+        mbase = extract_row(mrow)
+        assert mbase["metrics"]["async_dp2_sps_per_device"] == 60.0 \
+            and mbase["metrics"]["ingest_collectives"] == 0.0 \
+            and mbase["metrics"]["async_dp2_replay_ingest_jit_traces"] \
+            == 1.0, mbase["metrics"]
+        assert mbase["context"]["ring_shards"] == {"async_dp2": 2}, \
+            mbase["context"]
+        d = diff_rows({**mbase, "name": "mesh_self"},
+                      {**mbase, "name": "mesh_base"})
+        assert d["verdict"] == "ok" and not d["regressions"], d
+        leaky = dict(mbase, name="mesh_leaky",
+                     metrics={**mbase["metrics"],
+                              "async_dp2_sps_per_device": 40.0,
+                              "ingest_collectives": 1.0})
+        d = diff_rows(leaky, {**mbase, "name": "mesh_base"})
+        assert d["verdict"] == "regression", d
+        for m in ("async_dp2_sps_per_device", "ingest_collectives"):
             assert m in d["regressions"], (m, d["regressions"])
 
         # a widened tolerance declassifies a small regression

@@ -119,7 +119,12 @@ echo "== async smoke (decoupled actor/learner through the real CLI) =="
 # policy_lag/replay_lag/learner_idle_frac gauges + actor/learner phase
 # histograms in metrics.json, and an ASYNC-shaped row gating through
 # bench_diff (self-compare rc 0, injected env-steps/s regression rc 1)
-# — tools/async_smoke.py asserts all of it
+# — tools/async_smoke.py asserts all of it.  Its second stage forces 4
+# host devices in a fresh subprocess and proves the --async --mesh 4x1
+# composition: ring dp-sharded over all 4 devices, ZERO collectives on
+# the compiled ingest, one trace per entry point, a published version
+# adopted by an actor AND a serve VersionWatcher off --hot-swap-dir,
+# tp-only (1x4) refused with recarve instructions
 env JAX_PLATFORMS=cpu python tools/async_smoke.py
 
 echo "== flight smoke (series rings + async trace + black-box post-mortem) =="
