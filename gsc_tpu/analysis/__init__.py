@@ -9,6 +9,11 @@ telemetry contracts (PR 2), the precision-policy dtype discipline (PR 3)
   use-after-donation, impure trace-time state, missing
   ``preferred_element_type`` in bf16-policy modules, weak-type scalar
   args at jitted entry points).
+- :mod:`~gsc_tpu.analysis.concur` — the concurrency-discipline rules
+  (R6–R10: lock-order cycles, ``# guarded-by:`` field discipline,
+  multi-device dispatch outside ``dispatch_lock`` — the PR 18 deadlock
+  class — blocking calls while holding a lock, and unnamed/non-daemon
+  thread constructors), run through the same driver and baseline.
 - :mod:`~gsc_tpu.analysis.baseline` — the suppression baseline that
   encodes accepted pre-existing cases (each with a written reason), so
   CI fails only on NEW findings.
@@ -31,6 +36,7 @@ init.
 from .astlint import DONATED_SIGS, lint_files, lint_paths
 from .baseline import (apply_baseline, inline_suppression, load_baseline,
                        save_baseline)
+from .concur import DISPATCH_NAMES, check_concurrency
 from .findings import RULE_IDS, RULE_TITLES, Finding, LintResult
 from .hlo import count_fusions, count_ops, hlo_text
 from .sentinels import (DEFAULT_WATCH, CompileMonitor, HostSyncError,
@@ -38,6 +44,7 @@ from .sentinels import (DEFAULT_WATCH, CompileMonitor, HostSyncError,
 
 __all__ = [
     "DONATED_SIGS", "lint_files", "lint_paths",
+    "DISPATCH_NAMES", "check_concurrency",
     "apply_baseline", "inline_suppression", "load_baseline",
     "save_baseline",
     "RULE_IDS", "RULE_TITLES", "Finding", "LintResult",

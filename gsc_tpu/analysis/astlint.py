@@ -644,7 +644,9 @@ def lint_files(files: Sequence[str], rules: Optional[Set[str]] = None,
     """Raw (un-baselined) findings over ``files``.  ``root`` anchors the
     repo-relative paths used in fingerprints (default: cwd)."""
     root = os.path.abspath(root or os.getcwd())
-    rules = rules or {"R1", "R2", "R3", "R4", "R5"}
+    if not rules:
+        from .findings import RULE_IDS
+        rules = set(RULE_IDS)
     modules: List[ModuleIndex] = []
     for path in files:
         try:
@@ -666,6 +668,10 @@ def lint_files(files: Sequence[str], rules: Optional[Set[str]] = None,
                 decorated_names.add(info.name)
 
     findings: List[Finding] = []
+    # lazy import: concur borrows nothing from this module at import
+    # time, but keeping the edge one-directional avoids a cycle
+    from .concur import check_concurrency
+    concur_rules = {"R6", "R7", "R8", "R9", "R10"}
     for m in modules:
         policy_module = _is_policy_module(m.path)
         entry_names = decorated_names | m.jit_refs
@@ -680,7 +686,10 @@ def lint_files(files: Sequence[str], rules: Optional[Set[str]] = None,
                 _check_r4(ctx)
             if "R5" in rules:
                 _check_r5(ctx, entry_names)
-    # R1/R3 share one visitor, so filter to the requested subset here
+        if rules & concur_rules:
+            findings.extend(check_concurrency(m))
+    # R1/R3 (and the concurrency family's shared walker) emit together,
+    # so filter to the requested subset here
     findings = [f for f in findings if f.rule in rules]
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, len(modules)

@@ -16,7 +16,8 @@ from typing import Dict, List, Optional
 
 # rule ids are stable API — the baseline file, README table and fixture
 # tests all reference them
-RULE_IDS = ("R1", "R2", "R3", "R4", "R5")
+RULE_IDS = ("R1", "R2", "R3", "R4", "R5",
+            "R6", "R7", "R8", "R9", "R10")
 
 RULE_TITLES = {
     "R1": "host-sync call inside jit-traced code",
@@ -26,6 +27,14 @@ RULE_TITLES = {
           "preferred_element_type",
     "R5": "bare Python scalar passed to a jitted entry point "
           "(weak-type retrace)",
+    "R6": "lock-order cycle (same locks nested in opposite orders)",
+    "R7": "guarded-by field touched outside a `with` on its lock",
+    "R8": "multi-device dispatch in a thread-spawning module outside "
+          "dispatch_lock (the PR 18 deadlock class)",
+    "R9": "blocking call (untimed get/wait/join/result, nested "
+          "acquire, device call) while holding a lock",
+    "R10": "threading.Thread without name=/daemon= (unnamed threads "
+           "break watchdog/blackbox post-mortems)",
 }
 
 

@@ -1396,7 +1396,9 @@ def serve(agent_config, simulator_config, service, scheduler, checkpoint,
                     errors.append(f"client{tid}/{j}: {e}")
 
         t0 = _time.perf_counter()
-        threads = [threading.Thread(target=client, args=(i, n), daemon=True)
+        threads = [threading.Thread(target=client, args=(i, n),
+                                    name=f"gsc-serve-client-{i}",
+                                    daemon=True)
                    for i, n in enumerate(shares) if n]
         for t in threads:
             t.start()

@@ -85,18 +85,18 @@ class MetricsHub:
         self._lock = threading.RLock()
         self.base_tags: Dict[str, str] = {
             k: str(v) for k, v in (tags or {}).items()}
-        self._counters: Dict[_Key, float] = {}
-        self._gauges: Dict[_Key, float] = {}
-        self._live_gauges: Dict[_Key, object] = {}   # name -> callable
-        self._hists: Dict[_Key, _Histogram] = {}
-        self._beats: Dict[str, float] = {}       # name -> time.monotonic()
-        self._last_phase: Optional[str] = None
-        self._last_phase_done = False
+        self._counters: Dict[_Key, float] = {}        # guarded-by: self._lock
+        self._gauges: Dict[_Key, float] = {}          # guarded-by: self._lock
+        self._live_gauges: Dict[_Key, object] = {}    # guarded-by: self._lock
+        self._hists: Dict[_Key, _Histogram] = {}      # guarded-by: self._lock
+        self._beats: Dict[str, float] = {}            # guarded-by: self._lock
+        self._last_phase: Optional[str] = None        # guarded-by: self._lock
+        self._last_phase_done = False                 # guarded-by: self._lock
         # per-thread pipeline phase (fleet watchdog coverage): a wedged
         # actor's stall event names the phase IT was in, not the main
         # loop's
-        self._thread_phases: Dict[str, str] = {}
-        self._sinks: list = []
+        self._thread_phases: Dict[str, str] = {}      # guarded-by: self._lock
+        self._sinks: list = []                        # guarded-by: self._lock
         # time-series rings (the flight recorder; ``--obs-series-window``):
         # None = history off, series() is a no-op and every snapshot /
         # event byte stays identical to the history-free hub

@@ -64,7 +64,7 @@ class SeriesStore:
         self.window = int(window)
         self.base_tags: Dict[str, str] = dict(base_tags or {})
         self._lock = threading.Lock()
-        self._rings: Dict[_Key, deque] = {}
+        self._rings: Dict[_Key, deque] = {}   # guarded-by: self._lock
 
     # ------------------------------------------------------------- writes
     def add_point(self, name: str, value: float,

@@ -59,11 +59,12 @@ class JsonlSink:
         self.path = path
         self.rotate_bytes = int(max(rotate_mb, 0.0) * 2 ** 20)
         self._lock = threading.Lock()
-        self._file = open(path, "a")
+        self._file = open(path, "a")   # guarded-by: self._lock
 
-    def _rotate(self):
+    def _rotate(self):  # requires-lock: self._lock
         """Shift <path>.k -> <path>.k+1 (highest first), live -> .1,
-        reopen fresh.  Caller holds the lock.
+        reopen fresh.  Caller holds the lock (the ``requires-lock``
+        annotation above tells R7 so — emit() is the only caller).
 
         The live handle is retired via ``contextlib.closing`` rather
         than a direct ``.close()`` call: ``emit`` shares its name with a
@@ -106,7 +107,7 @@ class TailSink:
     would have seen); drop-oldest, thread-safe, O(1) per emit."""
 
     def __init__(self, maxlen: int = 256):
-        self._records = deque(maxlen=int(maxlen))
+        self._records = deque(maxlen=int(maxlen))   # guarded-by: self._lock
         self._lock = threading.Lock()
 
     def emit(self, record: Dict):

@@ -156,19 +156,19 @@ class SLOEngine:
         self.alpha = float(alpha)
         self._lock = threading.Lock()
         # (latency_ms, bucket) rolling window for attainment
-        self._window = deque(maxlen=max(int(window), 1))
-        self._requests = 0
-        self._deadline_misses = 0
-        self._errored = 0
-        self._lat_sum = 0.0
-        self._queue_wait_sum = 0.0
-        self._flushes = 0
-        self._pad_sum = 0.0
-        self._per_bucket: Dict[int, Dict[str, float]] = {}
-        self._rejected: Dict[str, int] = {}
-        self._last_arrival: Optional[float] = None
-        self._ia_ewma: Optional[float] = None
-        self._published_misses = 0
+        self._window = deque(maxlen=max(int(window), 1))   # guarded-by: self._lock
+        self._requests = 0                  # guarded-by: self._lock
+        self._deadline_misses = 0           # guarded-by: self._lock
+        self._errored = 0                   # guarded-by: self._lock
+        self._lat_sum = 0.0                 # guarded-by: self._lock
+        self._queue_wait_sum = 0.0          # guarded-by: self._lock
+        self._flushes = 0                   # guarded-by: self._lock
+        self._pad_sum = 0.0                 # guarded-by: self._lock
+        self._per_bucket: Dict[int, Dict[str, float]] = {}   # guarded-by: self._lock
+        self._rejected: Dict[str, int] = {}   # guarded-by: self._lock
+        self._last_arrival: Optional[float] = None   # guarded-by: self._lock
+        self._ia_ewma: Optional[float] = None        # guarded-by: self._lock
+        self._published_misses = 0          # guarded-by: self._lock
 
     # ------------------------------------------------------------ feeding
     def note_arrival(self, wall_ts: float):
@@ -239,7 +239,7 @@ class SLOEngine:
 
     # ----------------------------------------------------------- reading
     def _window_attainment(self, bucket: Optional[int] = None) \
-            -> Optional[float]:
+            -> Optional[float]:  # requires-lock: self._lock
         """Fraction of rolling-window requests meeting their applicable
         objective (bucket override else overall); None when no objective
         applies to any window entry.  Caller holds the lock."""
@@ -349,10 +349,10 @@ class ServeTracer:
         self.drain_interval_s = float(drain_interval_s)
         self.max_pending = int(max_pending)
         self.engine: Optional[SLOEngine] = None
-        self._pending: deque = deque()
-        self._dropped = 0
-        self._published_dropped = 0
-        self._flush_seq = 0
+        self._pending: deque = deque()   # guarded-by: self._append_lock
+        self._dropped = 0                # guarded-by: self._append_lock
+        self._published_dropped = 0      # guarded-by: self._append_lock
+        self._flush_seq = 0              # guarded-by: self._drain_lock
         self._drain_lock = threading.Lock()
         self._append_lock = threading.Lock()
         self._stop_event = threading.Event()
@@ -437,7 +437,7 @@ class ServeTracer:
         with self._append_lock:
             return self._dropped
 
-    def _drain_flush(self, rec: Dict):
+    def _drain_flush(self, rec: Dict):  # requires-lock: self._drain_lock
         bucket = rec["bucket"]
         n_real = rec["n_real"]
         t_dispatch = rec["t_dispatch"]

@@ -62,7 +62,7 @@ class PipelineWatchdog:
         # learner) watched alongside the main beat.  Each carries its own
         # stall/escalation state so one wedged actor re-arms
         # independently of a healthy learner.  name -> state dict
-        self._watched: Dict[str, Dict] = {}
+        self._watched: Dict[str, Dict] = {}   # guarded-by: self._watched_lock
         self._watched_lock = threading.Lock()
         # poll fast enough to flag a stall well inside one extra budget
         # interval, but never busier than 4 Hz

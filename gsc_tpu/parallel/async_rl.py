@@ -196,15 +196,17 @@ class _Channel:
     def __init__(self, max_outstanding: int):
         self.max_outstanding = int(max_outstanding)
         self._cond = threading.Condition()
-        self._blocks: deque = deque()
-        self.produced_steps = 0
-        self.ingested_steps = 0
-        self.max_observed_lag = 0
-        self._seq = 0
-        self._stop = False
+        self._blocks: deque = deque()   # guarded-by: self._cond
+        self.produced_steps = 0         # guarded-by: self._cond
+        self.ingested_steps = 0         # guarded-by: self._cond
+        self.max_observed_lag = 0       # guarded-by: self._cond
+        self._seq = 0                   # guarded-by: self._cond
+        self._stop = False              # guarded-by: self._cond
 
     def outstanding(self) -> int:
-        return self.produced_steps - self.ingested_steps
+        # writers call this under the cond; the learner/drain monitoring
+        # reads tolerate one-block staleness (ints, GIL-atomic)
+        return self.produced_steps - self.ingested_steps  # gsc-lint: disable=R7 -- put() holds the cond; monitor reads tolerate staleness
 
     def put(self, block, steps: int, version: int, shard: int = 0,
             timer=None,
@@ -301,10 +303,10 @@ class _FlightLedger:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.actor_eps: List[Dict] = []
-        self.ingests: List[List] = []
-        self.bursts: List[List] = []
-        self.publishes: List[List] = []
+        self.actor_eps: List[Dict] = []   # guarded-by: self._lock
+        self.ingests: List[List] = []     # guarded-by: self._lock
+        self.bursts: List[List] = []      # guarded-by: self._lock
+        self.publishes: List[List] = []   # guarded-by: self._lock
 
     def note_actor_episode(self, rec: Dict):
         with self._lock:
@@ -603,8 +605,13 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                         t_roll = time.time()
                         with (timer.phase("actor_dispatch") if timer
                               else _noop()):
+                            # R8 disabled below: the sharded binding's
+                            # wrapper takes dispatch_lock itself
+                            # (dp._bind_sharded_dispatch); the single-
+                            # device path has no partition rendezvous
+                            # to serialize
                             (a_state, scratch, env_states, obs,
-                             stats) = pddpg.rollout_episodes(
+                             stats) = pddpg.rollout_episodes(  # gsc-lint: disable=R8 -- wrapper holds dispatch_lock
                                 a_state, scratch, env_states, obs,
                                 topo, traffic, start, chunk)
                         if ledger is not None:
@@ -816,7 +823,10 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                 t_burst = time.time()
                 with (timer.phase("learn_dispatch") if timer
                       else _noop()):
-                    state, last_metrics = pddpg.learn_burst(state,
+                    # R8 disabled below: same invariant as the actor's
+                    # rollout dispatch — the sharded learn_burst wrapper
+                    # takes dispatch_lock itself (dp.py)
+                    state, last_metrics = pddpg.learn_burst(state,  # gsc-lint: disable=R8 -- wrapper holds dispatch_lock
                                                             buffers)
                 bursts += 1
                 if ledger is not None:

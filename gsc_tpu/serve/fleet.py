@@ -335,7 +335,7 @@ class VersionWatcher:
                              "in-process publisher")
         self.root = None if root is None else os.path.abspath(root)
         self.publisher = publisher
-        self._inbox: Optional[Tuple[Dict, object]] = None
+        self._inbox: Optional[Tuple[Dict, object]] = None   # guarded-by: self._inbox_lock
         self._inbox_lock = threading.Lock()
         if publisher is not None:
             self._subscription = publisher.subscribe(self._on_publish)
@@ -451,7 +451,7 @@ class FleetDispatcher:
         # proactive shedding (overflow shedding on queue_full stays on)
         self.brownout_burn = brownout_burn
         self.burn_refresh_s = float(burn_refresh_s)
-        self._burn_cache: Tuple[float, Optional[float]] = (0.0, None)
+        self._burn_cache: Tuple[float, Optional[float]] = (0.0, None)   # guarded-by: self._burn_lock
         self._burn_lock = threading.Lock()
         self._series_ts = 0.0   # last flight-recorder sample (monotonic)
 

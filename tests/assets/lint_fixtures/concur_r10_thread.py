@@ -1,0 +1,22 @@
+"""Seeded R10 violations: thread constructors missing ``name=`` and/or
+``daemon=`` — the watchdog and black-box post-mortems identify threads
+by name.  Expected: exactly two R10 findings (one missing both kwargs,
+one missing only ``daemon``); the fully-kwargged constructor is clean."""
+import threading
+
+
+def _work():
+    pass
+
+
+def spawn_anonymous():
+    return threading.Thread(target=_work)
+
+
+def spawn_named_not_daemon():
+    return threading.Thread(target=_work, name="fixture-worker")
+
+
+def spawn_disciplined():
+    return threading.Thread(target=_work, name="fixture-worker",
+                            daemon=True)

@@ -1,0 +1,20 @@
+"""Clean counterpart to concur_r6_cycle.py: the same two locks nested in
+ONE global order everywhere — no cycle, no findings."""
+import threading
+
+
+class ConsistentOrders:
+    def __init__(self):
+        self.flush_lock = threading.Lock()
+        self.swap_lock = threading.Lock()
+        self.value = 0
+
+    def writer(self):
+        with self.flush_lock:
+            with self.swap_lock:
+                self.value += 1
+
+    def swapper(self):
+        with self.flush_lock:
+            with self.swap_lock:
+                return self.value

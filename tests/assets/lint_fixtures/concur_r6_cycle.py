@@ -1,0 +1,21 @@
+"""Seeded R6 violation: two methods nest the same pair of locks in
+opposite orders — the classic ABBA deadlock.  Expected: exactly two R6
+findings (one per inner acquisition on the cycle)."""
+import threading
+
+
+class InvertedOrders:
+    def __init__(self):
+        self.flush_lock = threading.Lock()
+        self.swap_lock = threading.Lock()
+        self.value = 0
+
+    def writer(self):
+        with self.flush_lock:
+            with self.swap_lock:
+                self.value += 1
+
+    def swapper(self):
+        with self.swap_lock:
+            with self.flush_lock:
+                return self.value

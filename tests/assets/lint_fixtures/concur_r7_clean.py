@@ -1,0 +1,21 @@
+"""Clean counterpart to concur_r7_guarded.py: every touch of the
+guarded field holds the lock, and the private helper asserts its callers
+do via ``# requires-lock:`` — no findings."""
+import threading
+
+
+class GuardedCounterClean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0   # guarded-by: self._lock
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):  # requires-lock: self._lock
+        self.depth += 1
+
+    def peek(self):
+        with self._lock:
+            return self.depth

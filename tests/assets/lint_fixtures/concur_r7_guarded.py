@@ -1,0 +1,18 @@
+"""Seeded R7 violation: ``depth`` is declared guarded-by ``self._lock``
+but ``peek()`` reads it bare.  Expected: exactly one R7 finding in
+``GuardedCounter.peek`` (``bump`` holds the lock; ``__init__`` is exempt
+by construction-happens-before-publication)."""
+import threading
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0   # guarded-by: self._lock
+
+    def bump(self):
+        with self._lock:
+            self.depth += 1
+
+    def peek(self):
+        return self.depth

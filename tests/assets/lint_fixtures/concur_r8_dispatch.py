@@ -1,0 +1,28 @@
+"""Seeded R8 violation — the PR 18 deadlock shape: a thread-spawning
+module whose actor loop calls the multi-device dispatch entry point
+``rollout_episodes`` with NO ``dispatch_lock`` anywhere.  Two such
+threads interleave per-device enqueue order and wedge XLA's partition
+rendezvous.  Expected: exactly one R8 finding in ``Fleet._actor_loop``.
+"""
+import threading
+
+
+class Fleet:
+    def __init__(self, pddpg, state, buffers, keys):
+        self.pddpg = pddpg
+        self.state = state
+        self.buffers = buffers
+        self.keys = keys
+        self.running = True
+
+    def _actor_loop(self):
+        state, buffers = self.state, self.buffers
+        while self.running:
+            state, buffers, stats = self.pddpg.rollout_episodes(
+                state, buffers, self.keys)
+
+    def start(self):
+        t = threading.Thread(target=self._actor_loop,
+                             name="fixture-actor", daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,29 @@
+"""Clean counterpart to concur_r8_dispatch.py: the dispatch sits under
+``with self.dispatch_lock:`` so per-device enqueue order is serialized —
+no findings.  The lint test also DELETES the with-line from this source
+and re-lints to prove the PR 18 deadlock shape is re-detected the moment
+the lock disappears."""
+import threading
+
+
+class Fleet:
+    def __init__(self, pddpg, state, buffers, keys):
+        self.pddpg = pddpg
+        self.state = state
+        self.buffers = buffers
+        self.keys = keys
+        self.dispatch_lock = threading.Lock()
+        self.running = True
+
+    def _actor_loop(self):
+        state, buffers = self.state, self.buffers
+        while self.running:
+            with self.dispatch_lock:
+                state, buffers, stats = self.pddpg.rollout_episodes(
+                    state, buffers, self.keys)
+
+    def start(self):
+        t = threading.Thread(target=self._actor_loop,
+                             name="fixture-actor", daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,29 @@
+"""Seeded R9 violations: three ways to block while lexically holding a
+lock — an untimed ``queue.get()``, a nested manual ``.acquire()``, and a
+device call (``run_batch``).  Expected: exactly three R9 findings."""
+import queue
+import threading
+
+
+class BlocksUnderLock:
+    def __init__(self, run_batch):
+        self.flush_lock = threading.Lock()
+        self.aux_lock = threading.Lock()
+        self.q = queue.Queue()
+        self.run_batch = run_batch
+
+    def drain(self):
+        with self.flush_lock:
+            return self.q.get()
+
+    def double(self):
+        with self.flush_lock:
+            self.aux_lock.acquire()
+            try:
+                pass
+            finally:
+                self.aux_lock.release()
+
+    def flush(self, batch):
+        with self.flush_lock:
+            return self.run_batch(batch)

@@ -1,0 +1,28 @@
+"""Clean counterpart to concur_r9_blocking.py: the queue read carries a
+timeout, the second lock is taken via nested ``with`` (R6 can order-check
+it), and the device call runs after the lock is released — no findings.
+"""
+import queue
+import threading
+
+
+class YieldsUnderLock:
+    def __init__(self, run_batch):
+        self.flush_lock = threading.Lock()
+        self.aux_lock = threading.Lock()
+        self.q = queue.Queue()
+        self.run_batch = run_batch
+
+    def drain(self):
+        with self.flush_lock:
+            return self.q.get(timeout=0.5)
+
+    def double(self):
+        with self.flush_lock:
+            with self.aux_lock:
+                pass
+
+    def flush(self, batch):
+        with self.flush_lock:
+            todo = list(batch)
+        return self.run_batch(todo)

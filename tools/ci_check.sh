@@ -10,8 +10,44 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== gsc-lint (rules R1-R5, baseline: tools/gsc_lint_baseline.json) =="
+echo "== gsc-lint (rules R1-R10, baseline: tools/gsc_lint_baseline.json) =="
+# the summary line carries a stale-suppression count when the baseline
+# has drifted — `python tools/gsc_lint.py --prune-stale` clears it
 python tools/gsc_lint.py gsc_tpu/ tools/ bench.py
+
+echo "== gsc-lint self-check (concurrency rules must catch a seeded inversion) =="
+# negative control: a throwaway ABBA lock-order fixture MUST fail the
+# linter — if it passes, the R6-R10 pass is wired out of the gate and
+# the green lint stage above is meaningless.  Explicit rm (not a trap:
+# the tier-1 EXIT trap below would override it).
+SELFCHECK_DIR=$(mktemp -d /tmp/gsc_lint_selfcheck.XXXXXX)
+cat > "$SELFCHECK_DIR/inversion.py" <<'PYEOF'
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def fwd(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def rev(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass
+PYEOF
+if python tools/gsc_lint.py --no-baseline -q "$SELFCHECK_DIR/inversion.py" \
+        >/dev/null 2>&1; then
+    rm -rf "$SELFCHECK_DIR"
+    echo "ci_check: FAIL — gsc-lint passed a seeded lock-order inversion" >&2
+    exit 1
+fi
+rm -rf "$SELFCHECK_DIR"
+echo "ci_check: self-check OK (seeded inversion rejected)"
 
 echo "== obs_report selftest (event-schema smoke) =="
 python tools/obs_report.py --selftest

@@ -5,10 +5,13 @@ Usage:
     python tools/gsc_lint.py [paths...]            # default: gsc_tpu/ tools/ bench.py
     python tools/gsc_lint.py --json [paths...]
     python tools/gsc_lint.py --rules R1,R4 [paths...]
+    python tools/gsc_lint.py --changed [REF]       # only files in git diff REF
     python tools/gsc_lint.py --write-baseline      # accept current findings
+    python tools/gsc_lint.py --prune-stale         # drop baseline entries
+                                                   # that match nothing
     python tools/gsc_lint.py --no-baseline         # raw findings, no suppressions
 
-Rules (gsc_tpu/analysis/astlint.py):
+Rules (gsc_tpu/analysis/astlint.py + concur.py):
     R1  host-sync calls (.item(), float()/int() on arrays, np.asarray,
         block_until_ready, device_get) reachable from jitted/scanned code
     R2  use of a variable after it was passed as a donated argument
@@ -17,6 +20,18 @@ Rules (gsc_tpu/analysis/astlint.py):
         preferred_element_type
     R5  bare Python scalars passed to jitted entry points (weak-type
         retrace risk)
+    R6  lock-order cycle: two functions nest the same locks in opposite
+        orders (ABBA deadlock)
+    R7  field annotated ``# guarded-by: <lock>`` read/written without
+        holding that lock (``# requires-lock:`` on a def asserts callers
+        hold it)
+    R8  multi-device dispatch (chunk_step / rollout_episodes /
+        learn_burst / replay_ingest) in a thread-spawning module outside
+        ``with dispatch_lock:`` — the PR 18 partition-rendezvous deadlock
+    R9  blocking call (untimed get/wait/join/result, nested acquire,
+        device call) while holding a lock
+    R10 threading.Thread(...) without name=/daemon= (unnamed threads
+        break watchdog stall events and black-box post-mortems)
 
 Exit status: 0 when every finding is suppressed (baseline or inline
 ``gsc-lint: disable=R<k>`` marker), 1 when new findings exist, 2 on usage
@@ -44,12 +59,35 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from gsc_tpu.analysis import (  # noqa: E402
-    RULE_IDS, RULE_TITLES, lint_paths, load_baseline, save_baseline)
+    RULE_IDS, RULE_TITLES, load_baseline, save_baseline)
 from gsc_tpu.analysis.astlint import _iter_py_files, lint_files  # noqa: E402
+from gsc_tpu.analysis.baseline import build_result  # noqa: E402
 
 DEFAULT_PATHS = ("gsc_tpu/", "tools/", "bench.py")
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
                                 "gsc_lint_baseline.json")
+
+
+def _rel(path: str) -> str:
+    return os.path.relpath(os.path.abspath(path),
+                           REPO_ROOT).replace(os.sep, "/")
+
+
+def _git_changed_files(ref: str) -> Optional[List[str]]:
+    """Repo-relative paths changed vs ``ref`` (staged + unstaged), or
+    None when git is unavailable / this is not a work tree — the caller
+    falls back to a full scan rather than silently linting nothing."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return [ln.strip().replace(os.sep, "/")
+            for ln in proc.stdout.splitlines() if ln.strip()]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -68,6 +106,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="rewrite the baseline from current findings "
                          "(existing reasons preserved; new entries get a "
                          "TODO reason)")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="rewrite the baseline with stale entries "
+                         "(matching nothing in the linted scope) removed")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files in `git diff --name-only "
+                         "REF` [REF default: HEAD]; falls back to a full "
+                         "scan when git is unavailable")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset, e.g. R1,R4")
     ap.add_argument("--json", action="store_true", dest="as_json",
@@ -130,16 +176,62 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         return 0
 
-    result = lint_paths(
-        paths, baseline_path=None if args.no_baseline else args.baseline,
-        rules=rules, root=REPO_ROOT)
+    files = _iter_py_files(paths)
+    if args.changed is not None:
+        changed = _git_changed_files(args.changed)
+        if changed is None:
+            if not args.quiet:
+                print("gsc-lint: --changed: git unavailable, falling "
+                      "back to a full scan", file=sys.stderr)
+        else:
+            changed_set = set(changed)
+            files = [f for f in files if _rel(f) in changed_set]
+            if not files:
+                if args.as_json:
+                    json.dump({"files": 0, "findings": [],
+                               "suppressed": [], "stale_suppressions": [],
+                               "by_rule": {}, "ok": True},
+                              sys.stdout, indent=1)
+                    sys.stdout.write("\n")
+                elif not args.quiet:
+                    print("gsc-lint: no lintable files changed vs "
+                          f"{args.changed}")
+                return 0
+
+    all_entries = [] if args.no_baseline else load_baseline(args.baseline)
+    entries = all_entries
+    if rules:
+        entries = [e for e in entries
+                   if e.get("rule") in rules or not e.get("rule")]
+    raw, nfiles = lint_files(files, rules=rules, root=REPO_ROOT)
+    result = build_result(raw, entries, nfiles)
+
+    # an entry can only be called stale if this run actually re-checked
+    # its file — a scoped run (--changed, an explicit path subset) must
+    # not report (or prune) suppressions it never looked at
+    linted_rel = {_rel(f) for f in files}
+    stale = [e for e in result.stale_suppressions
+             if e.get("path") in linted_rel]
+
+    if args.prune_stale:
+        if args.no_baseline:
+            ap.error("--prune-stale needs the baseline "
+                     "(drop --no-baseline)")
+        prune = {e["fingerprint"] for e in stale}
+        if prune:
+            keep = [e for e in all_entries
+                    if e["fingerprint"] not in prune]
+            save_baseline(args.baseline, [], preserve=keep)
+        print(f"gsc-lint: pruned {len(prune)} stale suppression(s) -> "
+              f"{args.baseline}")
+        stale = []
 
     if args.as_json:
         json.dump({
             "files": result.files,
             "findings": [f.to_json() for f in result.findings],
             "suppressed": [f.to_json() for f in result.suppressed],
-            "stale_suppressions": result.stale_suppressions,
+            "stale_suppressions": stale,
             "by_rule": result.by_rule(),
             "ok": result.ok,
         }, sys.stdout, indent=1)
@@ -154,11 +246,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"gsc-lint: {result.files} files, "
               f"{len(result.findings)} finding(s)"
               + (f" ({detail})" if detail else "")
-              + f", {len(result.suppressed)} suppressed")
-        for e in result.stale_suppressions:
+              + f", {len(result.suppressed)} suppressed"
+              + (f", {len(stale)} stale" if stale else ""))
+        for e in stale:
             print(f"gsc-lint: stale suppression (matched nothing): "
-                  f"{e['fingerprint']} {e.get('path', '?')} — consider "
-                  "pruning")
+                  f"{e['fingerprint']} {e.get('path', '?')} — run "
+                  "--prune-stale to drop it")
     return 0 if result.ok else 1
 
 
