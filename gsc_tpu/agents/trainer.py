@@ -729,8 +729,14 @@ class Trainer:
                         else:
                             src = state.actor_params
                         if src is not None:
+                            # verified=True: both branches above ship a
+                            # finite-verified state (promoted snapshot,
+                            # or the live params whose flag just drained
+                            # finite) — skip the publisher's own host
+                            # scan
                             publisher.publish(jax.device_get(src),
-                                              meta={"episode": k + 1})
+                                              meta={"episode": k + 1},
+                                              verified=True)
                     return
                 if guard is None:
                     self._recover(
@@ -840,12 +846,11 @@ class Trainer:
         # already paid for — zero new host syncs
         self._note_cost_timings(
             timer, "episode_step" if pipeline else None)
-        if plan is not None and plan.unfired():
-            # a mis-keyed plan (episode index past the run's end, a site
-            # the run shape never reaches) must be loud: a chaos test
-            # whose fault never fired proves nothing
-            log.warning("fault plan entries never fired: %s",
-                        [f"{s.site}@{s.episode}" for s in plan.unfired()])
+        if plan is not None:
+            # shared end-of-run check (FaultPlan.warn_unfired): a
+            # mis-keyed plan must be loud on EVERY training path, with
+            # the same structured event
+            plan.warn_unfired(self.obs.hub if self.obs else None)
         if verbose:
             log.info("pipeline phase timings: %s", timer.summary())
         self.rewards_writer.close()
@@ -917,16 +922,14 @@ class Trainer:
         state skips the publish loudly instead of reaching the fleet).
 
         Resilience on this path: preemption stop + periodic checkpoints
-        (finite-verified host-side — there is no rollback guard here);
-        fault injection is NOT wired through the replica harness, so a
-        fault plan is refused up front rather than silently ignored."""
-        if self.fault_plan is not None:
-            # a chaos plan that never fires would make a replica run look
-            # exercised while proving nothing — refuse before any setup
-            raise ValueError(
-                "--fault-plan is not supported on the replica-parallel "
-                "path (train_parallel has no injection sites or rollback "
-                "guard); run the chaos plan with --replicas 1")
+        (finite-verified host-side).  Under a fault plan the harness
+        additionally wires ``nan_grads`` (the state entering the keyed
+        episode is poisoned) plus a host-side finite verify after EVERY
+        episode, backed by a ``RollbackGuard`` last-verified snapshot
+        when ``Trainer(rollback=True)`` — the replica loop drains
+        synchronously, so the carries after an episode ARE the verified
+        state and snapshots promote directly.  Without a plan none of
+        this runs: the production path is byte-identical to before."""
         if profile and self.result_dir:
             from ..utils.debug import Profiler
             with Profiler(os.path.join(self.result_dir, "profile")):
@@ -1019,6 +1022,14 @@ class Trainer:
             pddpg.init(jax.random.fold_in(base, 0), one_obs)
         buffers = init_buffers if init_buffers is not None else \
             pddpg.init_buffers(one_obs)
+
+        chaos = self.fault_plan
+        guard = None
+        if chaos is not None and self.rollback:
+            # chaos-only rollback target (tree_copy'd snapshots — the
+            # donating dispatch can never invalidate them)
+            guard = RollbackGuard()
+            guard.init(start_episode - 1, state, buffers)
 
         # one on-device sampler per scheduled topology (the scheduler
         # cycles training_network_files every `period` episodes); mixed
@@ -1203,6 +1214,14 @@ class Trainer:
                     finally:
                         if paused:
                             mon.start()
+                if chaos is not None:
+                    spec = chaos.fire("nan_grads", ep)
+                    if spec is not None:
+                        # the effect of a NaN gradient update: the state
+                        # entering this episode is poisoned; the chaos
+                        # verify below catches it at the episode's end
+                        state = state.replace(
+                            actor_params=poison_tree(state.actor_params))
                 if self.obs:
                     self.obs.episode_dispatched(ep)
                 state, buffers, rets, succ, final = run_chunked_episodes(
@@ -1219,6 +1238,29 @@ class Trainer:
                     # drained TD segments (the hook above updated the
                     # EWMAs) — gauges + one curriculum event per episode
                     curr.emit_weights(hub, ep)
+                if chaos is not None:
+                    # chaos-only episode-end verify (one host gather per
+                    # episode, NEVER on the production path): the replica
+                    # harness drains synchronously, so the carries here
+                    # are exactly the state after episode ep
+                    if self._finite_host(jax.device_get(state)):
+                        if guard is not None:
+                            guard.promote(ep, state, buffers,
+                                          pending_empty=True)
+                    elif guard is not None:
+                        tag, state, buffers = guard.restore()
+                        self._recover(
+                            ep, site="learner_state", action="rollback",
+                            fault="non_finite_state",
+                            detail=f"restored snapshot of episode {tag}; "
+                                   f"dropped poisoned episode {ep}")
+                    else:
+                        self._recover(
+                            ep, site="learner_state", action="detected",
+                            fault="non_finite_state",
+                            detail="rollback disabled (Trainer(rollback="
+                                   "False)) — continuing with the "
+                                   "poisoned state")
                 sps = ((ep - start_episode + 1) * steps_per_ep
                        * num_replicas / (time.time() - start))
                 row = {"episodic_return": rets[0],
@@ -1268,7 +1310,8 @@ class Trainer:
                     # publish cadence only, never per episode.
                     params = jax.device_get(state.actor_params)
                     if self._finite_host(params):
-                        publisher.publish(params, meta={"episode": ep + 1})
+                        publisher.publish(params, meta={"episode": ep + 1},
+                                          verified=True)
                     else:
                         self._recover(
                             ep, site="learner_state", action="detected",
@@ -1303,6 +1346,8 @@ class Trainer:
             if self.obs:
                 self.obs.pause_watchdog()
         self.completed_episodes = self._last_drained + 1
+        if chaos is not None:
+            chaos.warn_unfired(hub)
         self._note_cost_timings(timer, "chunk_step")
         self.rewards_writer.close()
         if self.tb:
@@ -1351,14 +1396,24 @@ class Trainer:
         and the serving fleet.  Tp-only meshes (dp=1 with >1 devices)
         are still refused — the ring has no dp axis to shard over.
 
-        When sync still wins (documented limits, refused loudly):
+        Resilience: the fleet is SUPERVISED — a dead actor thread
+        restarts from its episode counter within
+        ``AsyncConfig.restart_budget``, then the fleet degrades to fewer
+        actors (never hangs).  Under ``--fault-plan`` the async sites
+        (``actor_die@a<N>:<ep>``, ``ring_poison``, ``publish_corrupt@
+        v<N>``, ``watcher_stall``, ``learner_transient@<burst>``) fire
+        inside :func:`~gsc_tpu.parallel.async_rl.run_async`, the learner
+        finite-gates every popped block (poison quarantine) and keeps a
+        ``RollbackGuard`` last-verified snapshot keyed by the burst-level
+        ``state_finite`` flag; every recovery flows through
+        ``RunObserver.recovery``.  Without a plan none of that costs
+        anything — the fault-free path is byte-identical.
 
-        - ``--fault-plan`` — no injection sites or rollback guard here,
-          same refusal as train_parallel.
-        - Bit-exact learning curves vs the sync control — actors act on
-          K-burst-old weights by design; equivalence is BANDED
-          (bench_diff curve bands at matched env-step + gradient-step
-          budgets, tools/async_bench.py), never a digest.
+        One documented limit remains: bit-exact learning curves vs the
+        sync control — actors act on K-burst-old weights by design;
+        equivalence is BANDED (bench_diff curve bands at matched
+        env-step + gradient-step budgets, tools/async_bench.py), never a
+        digest.
 
         ``throttle_s`` artificially delays each burst (test/chaos knob
         for forcing backpressure); ``max_staleness`` bounds how many
@@ -1367,11 +1422,6 @@ class Trainer:
         run's measured accounting (learner idle fraction, policy-lag
         extrema, produced==ingested proof) lands in
         ``self.async_info``."""
-        if self.fault_plan is not None:
-            raise ValueError(
-                "--fault-plan is not supported on the async actor/learner "
-                "path (no injection sites or rollback guard); run the "
-                "chaos plan with --replicas 1")
         if plan is not None:
             # dp-sharded replay needs a dp axis; tp-only grids refuse
             # with the recarve instructions (partition.py)
@@ -1511,6 +1561,12 @@ class Trainer:
 
         start = time.time()
         drained_n = [0]
+        # episodes drain in COMPLETION order, so "max drained" could tag
+        # a preemption checkpoint after an episode whose predecessors
+        # never drained — the resume counter must advance only through
+        # the contiguous drained prefix (the gap re-runs on resume)
+        drained_set: set = set()
+        prefix = [start_episode - 1]
 
         def on_episode(rec, ring):
             """Learner-thread drain of one actor episode: the same
@@ -1565,7 +1621,11 @@ class Trainer:
                 # HBM spend on a pod
                 hub.gauge("replay_local_bytes",
                           buffer_nbytes(ring, local=True))
-            self._last_drained = max(self._last_drained, ep)
+            drained_set.add(ep)
+            while prefix[0] + 1 in drained_set:
+                prefix[0] += 1
+                drained_set.discard(prefix[0])
+            self._last_drained = prefix[0]
 
         def on_burst(n, st, metrics):
             if curr is None:
@@ -1580,10 +1640,14 @@ class Trainer:
                              np.asarray(sig["td_count"]))
 
         def checkpoint_fn(st, ring, n_drained):
-            # same finite-verified host-layout save as train_parallel
-            # (no rollback guard on this path either); under a plan the
-            # state gathers through the plan's fns so the checkpoint
-            # layout stays mesh-shape-agnostic (elastic resume)
+            # same finite-verified host-layout save as train_parallel —
+            # run_async's rollback guard (chaos runs) already keeps the
+            # state verified, but this host scan is the last line for
+            # guard-off runs; under a plan the state gathers through the
+            # plan's fns so the checkpoint layout stays
+            # mesh-shape-agnostic (elastic resume).  The episode tag is
+            # the CONTIGUOUS drained prefix (on_episode above), so a
+            # resume never skips an undrained episode.
             h_st = plan.gather_state(st) if plan is not None else st
             if self._finite_host(h_st):
                 ckpt_manager.save(h_st, jax.device_get(ring),
@@ -1613,7 +1677,15 @@ class Trainer:
                 checkpoint_every=(ckpt_interval if ckpt_manager
                                   is not None else 0),
                 checkpoint_fn=(checkpoint_fn if ckpt_manager is not None
-                               else None))
+                               else None),
+                fault_plan=self.fault_plan,
+                # the guard is chaos-scoped: a fault-free --async run
+                # stays byte-identical to the guard-free stack (no
+                # per-block finite dispatch, no snapshots);
+                # --no-rollback still disables it under a plan
+                rollback=(self.rollback and self.fault_plan is not None),
+                on_recovery=self._recover,
+                retry_policy=self.retry_policy)
         finally:
             if self.obs:
                 # drop the per-thread watches BEFORE pausing: a paused
@@ -1641,6 +1713,8 @@ class Trainer:
                                 exc_info=True)
         self.completed_episodes = self._last_drained + 1
         self.async_info = res.info
+        if self.fault_plan is not None:
+            self.fault_plan.warn_unfired(hub)
         if hub is not None:
             hub.event("async_train", **res.info)
         # phases-only merge (primary=None): the async ledger splits the

@@ -411,9 +411,15 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "structured 'invariant_violation' events")
 @click.option("--fault-plan", default=None,
               help="deterministic fault injection for chaos testing "
-                   "(resilience.FaultPlan grammar: 'site@episode[:arg]' "
-                   "joined by ';', sites: prefetch_die, slow_episode, "
-                   "dispatch_transient, nan_grads, ckpt_corrupt).  "
+                   "(resilience.FaultPlan grammar: 'site@key[:arg]' "
+                   "joined by ';').  Serial sites key by episode: "
+                   "prefetch_die, slow_episode, dispatch_transient, "
+                   "nan_grads, ckpt_corrupt.  Async fleet sites "
+                   "(--async): actor_die@a<actor>:<episode>, "
+                   "ring_poison@<episode>, publish_corrupt@v<version>, "
+                   "watcher_stall@a<actor>:<episode>[:sleep_s], "
+                   "learner_transient@<burst>.  nan_grads also fires on "
+                   "--replicas > 1 (host-verified, rollback-backed).  "
                    "Unset: the GSC_FAULT_PLAN env var; empty = no faults")
 @click.option("--rollback/--no-rollback", default=True, show_default=True,
               help="keep a last-good in-memory snapshot of (state, "
@@ -459,10 +465,12 @@ def _build(agent_config, simulator_config, service, scheduler, seed,
                    "ring lives dp-sharded on the learner mesh, ingest is "
                    "an AOT-compiled per-shard donated write (asserted "
                    "collective-free) and learn bursts run under the full "
-                   "pjit plan (tp-only meshes, dp=1, are refused).  Does "
-                   "not compose with --fault-plan yet; learning curves "
-                   "match the sync control within bench_diff's curve "
-                   "bands, not bit-exactly")
+                   "pjit plan (tp-only meshes, dp=1, are refused).  "
+                   "Composes with --fault-plan (async fleet sites; actor "
+                   "supervision + poison quarantine + rollback) and with "
+                   "--resume auto after a SIGTERM preemption; learning "
+                   "curves match the sync control within bench_diff's "
+                   "curve bands, not bit-exactly")
 @click.option("--async-actors", default=2, show_default=True,
               help="rollout threads for --async (each owns its own env "
                    "replicas batch, PRNG stream and adopted weights; "
@@ -553,11 +561,6 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
             raise click.BadParameter(
                 "--async decouples the replica rollout from the learner "
                 "— it requires the replica-parallel path (--replicas > 1)")
-        if fault_plan:
-            raise click.BadParameter(
-                "--async does not compose with --fault-plan yet: fault "
-                "injection assumes the synchronous episode loop's "
-                "dispatch points")
         if async_actors < 1:
             raise click.BadParameter("--async-actors must be >= 1")
         if max_staleness < 0:
@@ -849,7 +852,8 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                     from .serve.fleet import WeightPublisher
                     publisher = WeightPublisher(
                         hot_swap_dir,
-                        hub=(obs.hub if obs is not None else None))
+                        hub=(obs.hub if obs is not None else None),
+                        fault_plan=fplan)
                 if replicas > 1 and async_mode:
                     state, buffer = trainer.train_async(
                         episodes, num_replicas=replicas, chunk=chunk,
@@ -896,11 +900,22 @@ def train(agent_config, simulator_config, service, scheduler, episodes, seed,
                     obs.close(status="preempted")
                 result.metrics = {"status": "preempted"}
                 result.write()
-                click.echo(json.dumps({
+                payload = {
                     "status": "preempted", "signal": guard.signame,
                     "result_dir": rdir, "checkpoint": ckpt,
                     "episodes_completed": done,
-                    "hint": "continue with --resume auto"}))
+                    "hint": "continue with --resume auto"}
+                ainfo = getattr(trainer, "async_info", None)
+                if async_mode and ainfo:
+                    # the ASYNC_r02 drain proof, attached to the exit
+                    # line: a preempted async run must have drained the
+                    # channel fully before the snapshot above
+                    payload["drain"] = {
+                        k: ainfo[k] for k in (
+                            "produced_steps", "ingested_steps",
+                            "transitions_lost")
+                        if k in ainfo}
+                click.echo(json.dumps(payload))
                 return
 
             ckpt = save_checkpoint(os.path.join(rdir, "checkpoint"), state,

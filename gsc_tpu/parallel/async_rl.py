@@ -61,6 +61,27 @@ dispatch through the same plan-bound binding the sync path uses (tp
 rulebooks compose unchanged), and publishes gather params to host ONCE
 so the actor watchers and the serving fleet's hot-swap read the same
 weight bytes.
+
+Self-healing (``fault_plan`` / ``rollback``): production fleets assume
+workers die and restart routinely (Podracer, MindSpeed RL), so the loop
+is SUPERVISED rather than fail-fast.  An :class:`ActorSupervisor` tracks
+each actor's uncompleted episodes; a dead actor thread (exception or
+injected ``actor_die``) is restarted from its episode counter within a
+bounded per-actor restart budget, past which the fleet DEGRADES — the
+dead actor's episodes are reassigned to survivors and the default
+staleness cap is re-derived for the smaller fleet (never a hang: with
+zero survivors and episodes unrun, the run raises the last actor error).
+With ``rollback`` on, the learner finite-checks every popped block at
+its drain boundary and QUARANTINES poisoned blocks (an evidence event
+instead of an ingest — the ring never holds a NaN), and folds the
+per-burst ``state_finite`` flag into a :class:`RollbackGuard`-backed
+last-verified snapshot with one-burst-deferred verification, restoring
+(state, ring) and continuing when a burst lands non-finite.  All of it
+costs NOTHING when off: ``rollback=False`` + ``fault_plan=None`` (the
+default for direct callers) adds no device dispatch, no sync and no
+extra event to the fault-free path.  Every recovery flows through the
+caller's ``on_recovery`` (the Trainer routes it to
+``RunObserver.recovery``, same as the serial resilience ladder).
 """
 from __future__ import annotations
 
@@ -77,6 +98,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..agents.buffer import ReplayBuffer, buffer_nbytes
+from ..resilience.faults import FaultInjected
+from ..resilience.guard import RollbackGuard, all_finite, poison_tree
+from ..resilience.retry import (RetryPolicy, TransientDispatchError,
+                                call_with_retry)
 from .partition import (actor_shard_assignment, no_persistent_compile_cache,
                         ring_shard_rows)
 
@@ -159,6 +184,13 @@ def _finite_host(tree) -> bool:
                for l in jax.tree_util.tree_leaves(tree))
 
 
+# the quarantine probe: ONE device-side reduction per popped block, read
+# as a single host scalar — the verdict lands host-side (the drain
+# boundary's `_finite_host` discipline) without transferring the block.
+# Module-level jit so a warmup/measured run pair shares the trace.
+_block_finite = jax.jit(all_finite)
+
+
 @dataclass
 class AsyncConfig:
     """Knobs for the decoupled actor/learner loop."""
@@ -183,6 +215,9 @@ class AsyncConfig:
     # seconds the learner waits per idle poll (granularity of the
     # learner_idle phase, not a rate limit)
     idle_wait_s: float = 0.002
+    # supervised restarts per ACTOR before the fleet degrades to fewer
+    # actors (the dead actor's episodes are reassigned to survivors)
+    restart_budget: int = 2
 
 
 class _Channel:
@@ -254,6 +289,14 @@ class _Channel:
             if not self._blocks:
                 self._cond.wait(timeout)
 
+    def set_max_outstanding(self, n: int):
+        """Re-derive the backpressure cap (fleet degrade path): blocked
+        producers wake and re-check against the new bound, so shrinking
+        the cap can never wedge a putter mid-wait."""
+        with self._cond:
+            self.max_outstanding = int(n)
+            self._cond.notify_all()
+
     def stop(self):
         with self._cond:
             self._stop = True
@@ -277,6 +320,93 @@ class _ActorPolicy:
         self.params = jax.tree_util.tree_unflatten(self.treedef,
                                                    list(leaves))
         self.policy_version = int(version)
+
+
+class ActorSupervisor:
+    """Per-actor episode bookkeeping + the restart/degrade policy.
+
+    Each actor owns an ordered queue of its UNCOMPLETED episodes (seeded
+    with its strided assignment).  ``claim`` returns the head WITHOUT
+    popping — an actor that dies mid-episode re-runs that episode from
+    its start on restart (``complete`` pops only after the episode's
+    stats are staged, so a finished episode is never re-run; chunks a
+    dying actor already shipped are ingested twice on the re-run —
+    benign replay duplicates, never corruption, and drained records
+    never duplicate because stats only append at completion).
+
+    Failures queue here and the LEARNER loop supervises: within the
+    per-actor ``restart_budget`` it spawns a fresh thread resuming from
+    the dead actor's episode counter; past it the actor is degraded out
+    — its remaining episodes move to the orphan queue that surviving
+    actors drain after their own assignments (episode data is
+    scenario/seed-keyed by GLOBAL index, so WHO runs an episode never
+    changes WHAT it trains on).  With zero survivors and episodes still
+    unrun the learner raises the last actor error — the fleet never
+    hangs and never silently under-runs."""
+
+    def __init__(self, assignments: Dict[int, List[int]],
+                 restart_budget: int):
+        self._lock = threading.Lock()
+        # aid -> uncompleted episodes in run order (head = next to
+        # (re)run); guarded-by: self._lock
+        self._remaining = {aid: deque(eps)
+                           for aid, eps in assignments.items()}
+        self._orphans: deque = deque()     # guarded-by: self._lock
+        self._failures: deque = deque()    # guarded-by: self._lock
+        self.restart_budget = int(restart_budget)
+        # restarts/dead/errors: mutated by the learner thread only (the
+        # single supervisor), read post-join — no extra locking needed
+        self.restarts = {aid: 0 for aid in assignments}
+        self.dead: set = set()
+        self.errors: List[BaseException] = []
+
+    def claim(self, aid: int) -> Optional[int]:
+        """The actor's next episode (head, not popped), refilled from a
+        degraded actor's orphans once its own queue drains; None when
+        there is nothing left to run."""
+        with self._lock:
+            q = self._remaining[aid]
+            if not q and self._orphans:
+                q.append(self._orphans.popleft())
+            return q[0] if q else None
+
+    def complete(self, aid: int, episode: int):
+        with self._lock:
+            q = self._remaining[aid]
+            if q and q[0] == episode:
+                q.popleft()
+
+    def report_failure(self, aid: int, episode: int, exc: BaseException):
+        """Called from the dying actor thread; the learner's supervise
+        pass decides restart vs degrade."""
+        with self._lock:
+            self._failures.append((aid, episode, exc))
+
+    def pop_failure(self):
+        with self._lock:
+            return self._failures.popleft() if self._failures else None
+
+    def note_restart(self, aid: int) -> int:
+        self.restarts[aid] += 1
+        return self.restarts[aid]
+
+    def degrade(self, aid: int, exc: BaseException) -> int:
+        """Move the dead actor's episodes to the orphan queue; returns
+        the number of actors still alive."""
+        with self._lock:
+            self.dead.add(aid)
+            self._orphans.extend(self._remaining[aid])
+            self._remaining[aid].clear()
+            self.errors.append(exc)
+            return len(self._remaining) - len(self.dead)
+
+    def unrun(self) -> int:
+        with self._lock:
+            return (sum(len(q) for q in self._remaining.values())
+                    + len(self._orphans))
+
+    def total_restarts(self) -> int:
+        return sum(self.restarts.values())
 
 
 class _FlightLedger:
@@ -363,7 +493,10 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
               on_burst: Optional[Callable] = None,
               should_stop: Optional[Callable] = None,
               start_episode: int = 0, checkpoint_every: int = 0,
-              checkpoint_fn: Optional[Callable] = None) -> AsyncResult:
+              checkpoint_fn: Optional[Callable] = None,
+              fault_plan=None, rollback: bool = False,
+              on_recovery: Optional[Callable] = None,
+              retry_policy=None) -> AsyncResult:
     """Drive ``episodes - start_episode`` episodes through
     ``cfg.actor_threads`` rollout threads feeding the learner loop (the
     calling thread).  ``scenario_fn(ep) -> (topo, traffic)`` supplies
@@ -390,11 +523,28 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
     single-device ring.  Tp-only meshes (no dp axis) are refused up
     front via ``plan.assert_async_capable()``.
 
+    Self-healing: ``fault_plan`` (a
+    :class:`~gsc_tpu.resilience.faults.FaultPlan`) arms the fleet's
+    injection sites (``actor_die``/``ring_poison``/``watcher_stall``
+    keyed by actor episode, ``nan_grads``/``learner_transient`` keyed by
+    learn-burst index); ``rollback=True`` arms the drain-boundary block
+    quarantine and the burst-deferred :class:`RollbackGuard` snapshot;
+    ``on_recovery(episode, site=, action=, fault=, attempt=, detail=)``
+    receives every recovery (the Trainer routes it to
+    ``RunObserver.recovery``); ``retry_policy`` bounds the transient
+    learn-burst retries.  Actor supervision (restart within
+    ``cfg.restart_budget``, then degrade) is ALWAYS on — a dead actor
+    only kills the run once the whole fleet is exhausted.  The module
+    docstring has the full ladder; everything here is free when the
+    knobs stay at their defaults.
+
     Returns an :class:`AsyncResult`; ``info`` carries the drain-proved
     accounting: produced == ingested steps (no transition lost), the
     learner idle fraction, burst count, publish count, the observed
-    policy/replay lag extrema and — under a plan — ``ring_shards`` and
-    the AOT-mined ``ingest_collectives`` (always 0, by assertion)."""
+    policy/replay lag extrema, the self-healing ledger
+    (``actor_restarts``/``actors_degraded``/``blocks_quarantined``/
+    ``rollbacks``) and — under a plan — ``ring_shards`` and the
+    AOT-mined ``ingest_collectives`` (always 0, by assertion)."""
     plan = getattr(pddpg, "plan", None)
     if plan is not None:
         plan.assert_async_capable()
@@ -412,13 +562,17 @@ def run_async(pddpg, scenario_fn: Callable, state, buffers,
                 on_burst=on_burst, should_stop=should_stop,
                 start_episode=start_episode,
                 checkpoint_every=checkpoint_every,
-                checkpoint_fn=checkpoint_fn)
+                checkpoint_fn=checkpoint_fn, fault_plan=fault_plan,
+                rollback=rollback, on_recovery=on_recovery,
+                retry_policy=retry_policy)
     return _run_async_impl(
         pddpg, scenario_fn, state, buffers, episodes, episode_steps,
         chunk, seed, cfg, publisher=publisher, hub=hub, timer=timer,
         on_episode=on_episode, on_burst=on_burst, should_stop=should_stop,
         start_episode=start_episode, checkpoint_every=checkpoint_every,
-        checkpoint_fn=checkpoint_fn)
+        checkpoint_fn=checkpoint_fn, fault_plan=fault_plan,
+        rollback=rollback, on_recovery=on_recovery,
+        retry_policy=retry_policy)
 
 
 def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
@@ -428,7 +582,10 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                     on_burst: Optional[Callable] = None,
                     should_stop: Optional[Callable] = None,
                     start_episode: int = 0, checkpoint_every: int = 0,
-                    checkpoint_fn: Optional[Callable] = None) -> AsyncResult:
+                    checkpoint_fn: Optional[Callable] = None,
+                    fault_plan=None, rollback: bool = False,
+                    on_recovery: Optional[Callable] = None,
+                    retry_policy=None) -> AsyncResult:
     """The loop body of :func:`run_async` (which owns the plan
     validation and the run-wide compile-cache guard)."""
     from ..serve.fleet import VersionWatcher, WeightPublisher
@@ -457,15 +614,32 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
     results: deque = deque()
     results_lock = threading.Lock()
     stop_event = threading.Event()
-    actor_errors: List[BaseException] = []
     # the actors' first dispatches serialize under this lock so each
     # entry point traces exactly once (two threads racing an empty jit
     # cache would both trace — the zero-retrace contract forbids that)
     compile_lock = threading.Lock()
     scenario_lock = threading.Lock()
+    # quarantine + burst-rollback machinery only exists on guarded runs:
+    # the bare path (no plan, no rollback) dispatches nothing extra
+    guarded = rollback or fault_plan is not None
+
+    def recover(episode, site, action, fault=None, attempt=None,
+                detail=None):
+        if on_recovery is not None:
+            on_recovery(episode, site=site, action=action, fault=fault,
+                        attempt=attempt, detail=detail)
+        else:
+            log.warning("recovery: site=%s action=%s fault=%s "
+                        "episode=%s %s", site, action, fault, episode,
+                        detail or "")
 
     if publisher is None:
-        publisher = WeightPublisher(hub=hub)   # in-process channel only
+        # in-process channel only; the plan rides along so
+        # publish_corrupt@v<N> can corrupt the zero-copy path too
+        publisher = WeightPublisher(hub=hub, fault_plan=fault_plan)
+    elif fault_plan is not None and getattr(publisher, "fault_plan",
+                                            None) is None:
+        publisher.fault_plan = fault_plan
 
     plan = getattr(pddpg, "plan", None)
     n_shards = plan.n_devices if plan is not None else 1
@@ -533,6 +707,15 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
     def actor_episodes(aid):
         return range(start_episode + aid, episodes, n_actors)
 
+    supervisor = ActorSupervisor(
+        {a: list(actor_episodes(a)) for a in range(n_actors)},
+        restart_budget=cfg.restart_budget)
+    # last successful publish (version, params): a restarted actor seeds
+    # its policy from here — its fresh watcher inbox only sees FUTURE
+    # publishes.  Written by the learner thread, read by (re)starting
+    # actors; the tuple rebind is atomic and the params tree immutable.
+    latest_pub: List = [None]
+
     policy_lags: List[int] = []
     # flight recorder: the ledger only exists when the hub keeps series
     # history — with it off, run_async emits not one extra event and the
@@ -544,6 +727,14 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
     actor_wait_s = [0.0] * n_actors
     learner_idle_acc = [0.0]
 
+    # the actors' starting point, bound BEFORE the learner loop ever
+    # rebinds `state`: a restarted actor must stage from the same
+    # published-or-initial params as a first start, never from whatever
+    # unpublished learner state happens to be live at restart time
+    # (donate=False on this path keeps these buffers valid for the whole
+    # run)
+    init_state = state
+
     def actor_loop(aid: int):
         tname = f"actor{aid}"
         policy = _ActorPolicy(treedef)
@@ -552,10 +743,20 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
         # every actor starts from the published-or-initial params with
         # its OWN rng stream (identical streams would collapse the
         # exploration the replica axis exists to diversify)
-        a_state = state.replace(rng=jax.random.fold_in(state.rng,
-                                                       1000 + aid))
+        a_state = init_state.replace(
+            rng=jax.random.fold_in(init_state.rng, 1000 + aid))
+        pub = latest_pub[0]
+        if pub is not None:
+            # a RESTARTED actor re-adopts the latest published weights
+            # instead of regressing to the initial params (its fresh
+            # inbox only sees future publishes); on the first start
+            # nothing has been published and this is a no-op
+            policy.apply_weights(
+                jax.tree_util.tree_leaves(pub[1]), pub[0], None)
+            a_state = a_state.replace(actor_params=policy.params)
         first = True
         n_chunks = episode_steps // chunk
+        ep = -1   # the episode in flight, for the failure report
 
         def on_wait(waited: float):
             # one slot per actor, written only by this thread
@@ -564,9 +765,17 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                 hub.beat(tname)   # a backpressured actor is NOT wedged
 
         try:
-            for ep in actor_episodes(aid):
+            while True:
                 if stop_event.is_set():
                     return
+                nxt = supervisor.claim(aid)
+                if nxt is None:
+                    return
+                ep = nxt
+                if fault_plan is not None and fault_plan.fire(
+                        "actor_die", ep, actor=aid) is not None:
+                    raise FaultInjected(
+                        f"injected actor death: actor_die@a{aid}:{ep}")
                 with scenario_lock:
                     topo, traffic = scenario_fn(ep)
                 lock = compile_lock if first else None
@@ -593,7 +802,27 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                         # flush-lock discipline, by construction)
                         if hub is not None:
                             hub.note_thread_phase(tname, "adopt")
-                        if watcher.poll_once():
+                        try:
+                            spec = (fault_plan.fire("watcher_stall", ep,
+                                                    actor=aid)
+                                    if fault_plan is not None else None)
+                            if spec is not None:
+                                if spec.arg:
+                                    time.sleep(float(spec.arg))
+                                raise FaultInjected(
+                                    f"injected watcher stall: "
+                                    f"watcher_stall@a{aid}:{ep}")
+                            swapped = watcher.poll_once()
+                        except Exception as e:
+                            # a stalled/failing poll must not kill the
+                            # actor: skip THIS adoption, keep acting on
+                            # the current weights, adopt next chunk
+                            swapped = False
+                            recover(ep, site="watcher",
+                                    action="skip_adopt",
+                                    fault=type(e).__name__,
+                                    detail=f"actor {aid}: {e}")
+                        if swapped:
                             a_state = a_state.replace(
                                 actor_params=policy.params)
                             if ledger is not None:
@@ -621,8 +850,14 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                         chunk_stats.append(stats)
                         if hub is not None:
                             hub.note_thread_phase(tname, "blocked_put")
+                        out_block = scratch.data
+                        if fault_plan is not None and fault_plan.fire(
+                                "ring_poison", ep) is not None:
+                            # poison a COPY: scratch is this actor's
+                            # live carry for the next rollout dispatch
+                            out_block = poison_tree(scratch.data)
                         wait0 = actor_wait_s[aid]
-                        seq = channel.put(scratch.data, B * chunk,
+                        seq = channel.put(out_block, B * chunk,
                                           policy.policy_version,
                                           shard=shard_of[aid],
                                           timer=timer, on_wait=on_wait)
@@ -648,8 +883,9 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                                     "policy_version":
                                         policy.policy_version,
                                     "chunk_stats": chunk_stats})
-        except BaseException as e:   # surfaced by the learner loop
-            actor_errors.append(e)
+                supervisor.complete(aid, ep)
+        except BaseException as e:   # supervised by the learner loop
+            supervisor.report_failure(aid, ep, e)
             log.exception("actor %d died", aid)
         finally:
             watcher.stop()   # drops the publisher subscription; an
@@ -660,8 +896,16 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                for a in range(n_actors)]
     steps_per_burst = B * episode_steps   # the sync control's cadence
     bursts = publishes = last_ckpt = 0
+    blocks_quarantined = steps_quarantined = 0
     drained: List[Dict] = []
     last_metrics = None
+    guard = None
+    pending_verify = None   # (burst_idx, device flag) awaiting its sync
+    if rollback:
+        guard = RollbackGuard()
+        # seed with the (trivially finite) entry state so a poisoned
+        # FIRST burst still has a restore target
+        guard.init(start_episode - 1, state, buffers)
     t_start = time.perf_counter()
     for t in threads:
         t.start()
@@ -704,8 +948,12 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
             params = state.actor_params
             finite = _finite_host(params)
         if finite:
+            # verified=True: the gate above already proved the leaves
+            # finite, so the publisher skips its own (redundant) scan
             publisher.publish(params, meta={"burst": bursts,
-                                            "episodes": len(drained)})
+                                            "episodes": len(drained)},
+                              verified=True)
+            latest_pub[0] = (publisher.version, params)
             publishes += 1
             if ledger is not None:
                 ledger.note_publish(time.time(), publisher.version)
@@ -715,6 +963,38 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                         "actors", bursts)
             if hub is not None:
                 hub.counter("async_publish_skipped_total")
+
+    def do_rollback(episode, detail):
+        nonlocal state, buffers, pending_verify
+        tag, s, b = guard.restore()
+        state, buffers = s, b   # fresh copies — donation-safe carries
+        pending_verify = None   # descendants of the poisoned state
+        recover(episode, site="learner_state", action="rollback",
+                fault="non_finite_state",
+                detail=f"{detail}; restored last-verified snapshot "
+                       f"(tag {tag})")
+        if hub is not None:
+            hub.counter("async_rollbacks_total")
+
+    def verify_pending():
+        """One-burst-deferred finite verdict: the LAST burst's
+        ``state_finite`` flag syncs here (a single device scalar) right
+        before the next burst dispatches — the flag's compute has had a
+        full loop pass to finish, so the read rarely blocks the hot
+        path.  Finite promotes the live carries to the guard's
+        last-verified snapshot (blocks ingested since the burst are
+        quarantine-checked, so the ring is still clean); non-finite
+        restores that snapshot and the run continues."""
+        nonlocal pending_verify
+        if guard is None or pending_verify is None:
+            return
+        b_idx, flag = pending_verify
+        pending_verify = None
+        if bool(float(flag) > 0.0):
+            guard.promote(b_idx, state, buffers, pending_empty=True)
+        else:
+            do_rollback(len(drained),
+                        f"learn-burst {b_idx} landed non-finite")
 
     def check_stop():
         # polled at EVERY progress point, not just the outer loop top: a
@@ -745,18 +1025,66 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
             flags = [float(s["state_finite"]) for s in stats
                      if "state_finite" in s]
             rec["state_finite"] = bool(min(flags) > 0) if flags else None
+            if guard is not None and rec["state_finite"] is False:
+                # the actor acted on a non-finite state: same restore
+                # path as a poisoned burst — the per-episode flag folds
+                # into the guard instead of merely riding the record
+                do_rollback(rec["episode"],
+                            f"episode {rec['episode']} drained with a "
+                            f"non-finite state flag")
             drained.append(rec)
             if on_episode is not None:
                 on_episode(rec, buffers)
 
     actors_alive = lambda: any(t.is_alive() for t in threads)  # noqa: E731
+
+    def spawn_actor(aid: int, suffix: str = ""):
+        t = threading.Thread(target=actor_loop, args=(aid,),
+                             name=f"gsc-actor-{aid}{suffix}", daemon=True)
+        threads.append(t)
+        t.start()
+
+    def supervise():
+        """Drain queued actor failures (learner thread only): restart
+        within the per-actor budget, else degrade the fleet — reassign
+        the dead actor's episodes to survivors and re-derive the default
+        staleness cap for the smaller fleet."""
+        while True:
+            fail = supervisor.pop_failure()
+            if fail is None:
+                return
+            aid, at_ep, exc = fail
+            if stop_event.is_set():
+                # stopping anyway: record the death, respawn nothing
+                supervisor.degrade(aid, exc)
+                continue
+            if supervisor.restarts[aid] < supervisor.restart_budget:
+                n = supervisor.note_restart(aid)
+                recover(at_ep, site="actor", action="restart",
+                        fault=type(exc).__name__, attempt=n,
+                        detail=f"actor {aid} died at episode {at_ep}; "
+                               f"restarting from its episode counter "
+                               f"({n}/{supervisor.restart_budget})")
+                if hub is not None:
+                    hub.counter("actor_restarts_total")
+                spawn_actor(aid, suffix=f"-r{n}")
+            else:
+                alive = supervisor.degrade(aid, exc)
+                detail = (f"actor {aid} exhausted its restart budget "
+                          f"({supervisor.restart_budget}); fleet "
+                          f"degrades to {alive} actor(s)")
+                if cfg.max_staleness <= 0 and alive > 0:
+                    new_cap = 2 * alive * B * episode_steps
+                    channel.set_max_outstanding(new_cap)
+                    detail += f"; staleness cap re-derived to {new_cap}"
+                recover(at_ep, site="actor", action="degrade",
+                        fault=type(exc).__name__, detail=detail)
+                if hub is not None:
+                    hub.counter("actor_degraded_total")
+
     try:
         while True:
-            if actor_errors:
-                stop_event.set()
-                channel.stop()
-                raise RuntimeError(
-                    "async actor thread died") from actor_errors[0]
+            supervise()
             check_stop()
             progressed = False
             # pop EVERYTHING queued before dispatching a single ingest:
@@ -773,6 +1101,31 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                 items.append(item)
                 item = channel.get_nowait()
             for block, steps, version, seq, shard in items:
+                if guarded:
+                    # drain-boundary quarantine: ONE device reduction +
+                    # one scalar host read per popped block.  A poisoned
+                    # block is DROPPED with an evidence row — the ring
+                    # never holds a NaN, and the drain accounting still
+                    # balances (the pop already counted the steps as
+                    # ingested; the quarantined tally rides info).
+                    with dispatch_lock:
+                        block_ok = bool(float(_block_finite(block)) > 0.0)
+                    if not block_ok:
+                        blocks_quarantined += 1
+                        steps_quarantined += int(steps)
+                        recover(len(drained), site="replay",
+                                action="quarantine",
+                                fault="non_finite_block",
+                                detail=f"seq={seq} shard={shard} "
+                                       f"steps={steps} version={version}")
+                        if hub is not None:
+                            hub.counter("replay_quarantined_total")
+                            hub.event("replay_quarantine", seq=int(seq),
+                                      shard=int(shard), steps=int(steps),
+                                      policy_version=int(version))
+                        progressed = True
+                        check_stop()
+                        continue
                 if hub is not None:
                     hub.note_thread_phase("learner", "ingest")
                 t_ing = time.time()
@@ -818,17 +1171,50 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                 last_ckpt = len(drained)
                 checkpoint_fn(state, buffers, len(drained))
             if bursts < allowance():
+                verify_pending()   # may rollback + rebind the carries
+                b_idx = bursts     # 0-based index of this burst
+                if fault_plan is not None and fault_plan.fire(
+                        "nan_grads", b_idx) is not None:
+                    # async nan_grads is BURST-keyed: poison the state
+                    # entering this burst; the deferred flag catches it
+                    # one burst later and the guard restores
+                    state = state.replace(
+                        actor_params=poison_tree(state.actor_params))
                 if hub is not None:
                     hub.note_thread_phase("learner", "learn_burst")
                 t_burst = time.time()
-                with (timer.phase("learn_dispatch") if timer
-                      else _noop()):
-                    # R8 disabled below: same invariant as the actor's
-                    # rollout dispatch — the sharded learn_burst wrapper
-                    # takes dispatch_lock itself (dp.py)
-                    state, last_metrics = pddpg.learn_burst(state,  # gsc-lint: disable=R8 -- wrapper holds dispatch_lock
-                                                            buffers)
+
+                def dispatch_burst():
+                    if fault_plan is not None and fault_plan.fire(
+                            "learner_transient", b_idx) is not None:
+                        raise TransientDispatchError(
+                            f"injected transient at learn-burst {b_idx}")
+                    with (timer.phase("learn_dispatch") if timer
+                          else _noop()):
+                        # R8 disabled below: same invariant as the
+                        # actor's rollout dispatch — the sharded
+                        # learn_burst wrapper takes dispatch_lock
+                        # itself (dp.py)
+                        return pddpg.learn_burst(state, buffers)  # gsc-lint: disable=R8 -- wrapper holds dispatch_lock
+
+                if guarded:
+                    # the transient class retries with backoff (the
+                    # fault fires at entry, before anything dispatches,
+                    # so a re-run consumes nothing)
+                    state, last_metrics = call_with_retry(
+                        dispatch_burst, retry_policy or RetryPolicy(),
+                        on_retry=lambda attempt, exc, delay: recover(
+                            len(drained), site="learner", action="retry",
+                            fault=type(exc).__name__, attempt=attempt,
+                            detail=f"learn-burst {b_idx}: {exc} "
+                                   f"(backoff {delay:.2f}s)"))
+                else:
+                    state, last_metrics = dispatch_burst()
                 bursts += 1
+                if guard is not None and hasattr(last_metrics, "get"):
+                    flag = last_metrics.get("state_finite")
+                    if flag is not None:
+                        pending_verify = (b_idx, flag)
                 if ledger is not None:
                     ledger.note_burst(t_burst, time.time(), bursts)
                 if hub is not None:
@@ -841,6 +1227,31 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
                 progressed = True
             if not progressed:
                 if not actors_alive() and channel.outstanding() == 0:
+                    supervise()   # a just-queued failure may restart
+                    if actors_alive() or channel.outstanding():
+                        continue
+                    if supervisor.unrun() and not stop_event.is_set():
+                        # orphans with no live owner: respawn a cleanly-
+                        # exited actor to drain them (degraded actors
+                        # stay dead); with every actor past its budget,
+                        # raise — never hang, never silently under-run
+                        cand = [a for a in range(n_actors)
+                                if a not in supervisor.dead]
+                        if cand:
+                            recover(len(drained), site="actor",
+                                    action="restart", fault=None,
+                                    detail=f"actor {cand[0]} respawned "
+                                           f"to drain "
+                                           f"{supervisor.unrun()} "
+                                           f"orphaned episode(s)")
+                            spawn_actor(cand[0], suffix="-orphans")
+                            continue
+                        raise RuntimeError(
+                            f"async fleet exhausted: every actor is "
+                            f"past its restart budget "
+                            f"({supervisor.restart_budget}) with "
+                            f"{supervisor.unrun()} episode(s) unrun"
+                        ) from supervisor.errors[-1]
                     break
                 if hub is not None:
                     hub.note_thread_phase("learner", "idle")
@@ -857,6 +1268,10 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
         for t in threads:
             t.join(timeout=30.0)
     drain_results()
+    # final deferred verdict: with rollback on, the returned state is
+    # ALWAYS verified — a burst poisoned at the very end restores here,
+    # so preemption snapshots and final checkpoints never hold a NaN
+    verify_pending()
     # graceful drain: nothing in flight, nothing lost, no future hung
     jax.block_until_ready((state, buffers))
     wall = time.perf_counter() - t_start
@@ -894,6 +1309,13 @@ def _run_async_impl(pddpg, scenario_fn: Callable, state, buffers,
         "actor_idle_frac": max(actor_fracs) if actor_fracs else 0.0,
         "ring_shards": n_shards,
         "mesh": plan.describe() if plan is not None else None,
+        # self-healing ledger (all zero on a clean run; the chaos stage
+        # and bench_diff's informational keys read these)
+        "actor_restarts": supervisor.total_restarts(),
+        "actors_degraded": len(supervisor.dead),
+        "blocks_quarantined": blocks_quarantined,
+        "steps_quarantined": steps_quarantined,
+        "rollbacks": guard.rollbacks if guard is not None else 0,
         # AOT-mined collective count on the ingest hot path; the prewarm
         # RAISES if it is ever nonzero, so a plan run always reports 0
         "ingest_collectives": ingest_collectives,

@@ -54,6 +54,7 @@ import re
 import tempfile
 import threading
 import time
+import zipfile
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -124,7 +125,7 @@ class WeightPublisher:
 
     def __init__(self, root: Optional[str] = None, keep_versions: int = 8,
                  hub=None, artifact_cache=None, artifact_keep: int = 8,
-                 subscribers: Sequence[Callable] = ()):
+                 subscribers: Sequence[Callable] = (), fault_plan=None):
         if keep_versions < 1:
             raise ValueError(f"keep_versions must be >= 1: {keep_versions}")
         self.root = None if root is None else os.path.abspath(root)
@@ -132,6 +133,9 @@ class WeightPublisher:
             os.makedirs(self.root, exist_ok=True)
         self.keep_versions = int(keep_versions)
         self.hub = hub
+        # chaos hook: the publish_corrupt site fires here, keyed by the
+        # published version (None outside injected runs)
+        self.fault_plan = fault_plan
         # the serving tier's compiled-policy cache (optional): pruned
         # after each publish so per-fingerprint artifact sets don't
         # accumulate one generation per published version
@@ -166,10 +170,48 @@ class WeightPublisher:
         """The last published version (0 = nothing published yet)."""
         return self._version
 
-    def publish(self, params, meta: Optional[Dict] = None) -> Dict:
-        """Write the next version; returns the manifest record."""
+    @staticmethod
+    def _params_finite(params) -> bool:
+        """Host-side finite scan over every inexact leaf (one host read
+        per leaf — publish cadence, never a dispatch path)."""
+        import jax
+        for leaf in jax.tree_util.tree_flatten(params)[0]:
+            arr = np.asarray(jax.device_get(leaf))
+            if np.issubdtype(arr.dtype, np.floating) \
+                    and not np.isfinite(arr).all():
+                return False
+        return True
+
+    def publish(self, params, meta: Optional[Dict] = None,
+                verified: bool = False) -> Optional[Dict]:
+        """Write the next version; returns the manifest record, or None
+        when the finite gate refuses the params.
+
+        BOTH delivery channels are finite-gated: a non-finite tree never
+        bumps the version, never writes an artifact and never reaches a
+        subscriber — a poisoned learner state cannot fan out to actors
+        or the hot-swap fleet through either path.  Callers that already
+        proved the leaves finite (run_async's ``maybe_publish``, the
+        trainer's pre-publish gates) pass ``verified=True`` to skip the
+        redundant host scan."""
+        if not verified and not self._params_finite(params):
+            log.warning("publish refused: non-finite leaves at version "
+                        "%d — a poisoned version must never reach a "
+                        "watcher", self._version + 1)
+            if self.hub is not None:
+                self.hub.counter("weight_publish_skipped_total")
+                self.hub.event("weight_publish_skipped",
+                               version=self._version + 1,
+                               reason="non_finite")
+            return None
         version = self._version + 1
         name = _vname(version)
+        # injected in-flight corruption, keyed by the published version:
+        # the artifact/leaves are corrupted AFTER the gate above, so the
+        # watchers' validation (fingerprint on the file path, the finite
+        # gate on the in-process path) is what must catch it
+        corrupt = (self.fault_plan.fire("publish_corrupt", version)
+                   if self.fault_plan is not None else None)
         if self.root is None:
             record = {
                 "format": WEIGHTS_FORMAT,
@@ -197,6 +239,15 @@ class WeightPublisher:
                 except OSError:
                     pass
                 raise
+            if corrupt is not None:
+                # flip one byte mid-blob: the manifest keeps the CLEAN
+                # fingerprint, so load_version's content check fails and
+                # every watcher parks this version
+                with open(blob_path, "r+b") as f:
+                    f.seek(os.path.getsize(blob_path) // 2)
+                    b = f.read(1) or b"\x00"
+                    f.seek(-len(b), os.SEEK_CUR)
+                    f.write(bytes([b[0] ^ 0xFF]))
             record = {
                 "format": WEIGHTS_FORMAT,
                 "version": version,
@@ -226,9 +277,15 @@ class WeightPublisher:
                            fingerprint=record["fingerprint"],
                            **({"meta": meta} if meta else {}))
             self.hub.gauge("serve_published_version", version)
+        deliver = params
+        if corrupt is not None:
+            # in-process corruption: subscribers receive NaN leaves —
+            # the VersionWatcher's finite gate must park the version
+            from ..resilience.guard import poison_tree
+            deliver = poison_tree(params)
         for sub in list(self.subscribers):
             try:   # a broken subscriber must not fail the fleet publish
-                sub(record, params)
+                sub(record, deliver)
             except Exception:
                 log.exception("publish subscriber failed at version %d",
                               version)
@@ -298,7 +355,9 @@ def load_version(root: str, record: Dict) -> List[np.ndarray]:
     try:
         with np.load(blob_path) as z:
             leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
-    except (OSError, ValueError, KeyError) as e:
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+        # BadZipFile: npz member reads are CRC-checked, so a torn or
+        # bit-flipped blob can surface here before the fingerprint pass
         raise ValueError(f"weights blob unreadable: {blob_path} "
                          f"({type(e).__name__}: {e})")
     if _leaf_sig(leaves) != record.get("leaves"):
@@ -405,6 +464,19 @@ class VersionWatcher:
             if self.publisher is not None:
                 import jax
                 leaves = jax.tree_util.tree_leaves(params)
+                # the in-process analogue of the file path's fingerprint
+                # validation: a published version with non-finite leaves
+                # must never be adopted.  ValueError routes through the
+                # same parked-retry bookkeeping below (one host read per
+                # leaf, publish cadence only).
+                for i, leaf in enumerate(leaves):
+                    arr = np.asarray(jax.device_get(leaf))
+                    if np.issubdtype(arr.dtype, np.floating) \
+                            and not np.isfinite(arr).all():
+                        raise ValueError(
+                            f"non-finite leaf {i} in in-process "
+                            f"published version {rec['version']} — "
+                            f"refusing to adopt")
             else:
                 leaves = load_version(self.root, rec)
             self.server.apply_weights(leaves, rec["version"],

@@ -125,6 +125,7 @@ METRIC_RULES: List[Tuple] = [
 # layout), arbitrarily deep below the scan root.
 SCAN_PATTERNS = ("BENCH_r*.json", "MULTICHIP_r*.json", "SERVE_r*.json",
                  "MIXTOPO_r*.json", "SCEN_r*.json", "ASYNC_r*.json",
+                 "CHAOS_r*.json",
                  "**/perf.json", "**/curves.json", "**/slo.json")
 
 
@@ -176,6 +177,16 @@ def _bench_row(d: Dict) -> Dict:
                   # staleness + worst per-actor idle gate under their
                   # own lower-is-better bands
                   "policy_lag_p99", "actor_idle_frac",
+                  # CHAOS rounds (tools/chaos_smoke.py --round): the
+                  # fault-injected vs fault-free rates gate under the
+                  # shared 15% `_sps` band — self-healing must cost
+                  # recovery DETOURS, not steady-state throughput.  The
+                  # recovery tallies land as informational keys (no
+                  # band: how many faults a plan fires is the plan's
+                  # business, drift is context not regression)
+                  "chaos_sps", "control_sps", "chaos_vs_control",
+                  "recoveries_total", "actor_restarts",
+                  "blocks_quarantined",
                   "sync_final_window_return", "async_final_window_return",
                   "sync_auc_return", "async_auc_return"):
             if _num(d.get(k)) is not None:

@@ -176,9 +176,15 @@ echo "== flight smoke (series rings + async trace + black-box post-mortem) =="
 env JAX_PLATFORMS=cpu python tools/flight_smoke.py
 
 echo "== chaos smoke (resilience: injected faults must self-heal) =="
-# a tiny CPU train run under an injected prefetcher death + NaN episode
-# must exit 0 with matching structured `recovery` events in events.jsonl
-# (tools/chaos_smoke.py asserts the events and the finite final state)
+# two legs: a tiny CPU train run under an injected prefetcher death +
+# NaN episode, then a fresh-subprocess real-CLI `train --async` run
+# under actor_die@a0:1;ring_poison@2;learner_transient@3 — both must
+# exit 0 with matching structured `recovery` events in events.jsonl;
+# the async leg additionally proves the drain accounting (produced ==
+# ingested, zero transitions lost past the quarantined block) and that
+# no poisoned version was ever adopted (tools/chaos_smoke.py asserts
+# all of it; `--round` banks the CHAOS_r* bench row with the mid-run
+# SIGTERM + --resume auto continuation)
 env JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 echo "== tier-1 tests (ROADMAP.md verify command) =="
